@@ -1,0 +1,31 @@
+"""TPU-native spatial particle redistribution over Cartesian device meshes.
+
+A ground-up JAX/TPU rebuild of the capabilities of
+``dkorytov/mpi_grid_redistribute`` (reference mount was empty at build time;
+spec from BASELINE.json / SURVEY.md): bin particles to the shard that owns
+their subvolume, pack by destination, and exchange everything in one
+capacity-padded ``lax.all_to_all`` over a ``jax.sharding.Mesh`` mirroring
+the Cartesian process grid — the classic digitize -> histogram ->
+sort-by-destination -> all-to-all pipeline, SPMD on ICI instead of mpi4py
+``Alltoallv`` on an MPI fabric.
+"""
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.api import (
+    GridRedistribute,
+    RedistributeResult,
+    redistribute,
+)
+from mpi_grid_redistribute_tpu.parallel.exchange import RedistributeStats
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Domain",
+    "ProcessGrid",
+    "GridRedistribute",
+    "RedistributeResult",
+    "RedistributeStats",
+    "redistribute",
+    "__version__",
+]

@@ -1,0 +1,204 @@
+"""Public API: ``GridRedistribute`` + ``redistribute()`` (SURVEY.md §3.1-3.2).
+
+Mirrors the reference's entry point — construct with domain bounds and a
+process-grid shape, then call ``redistribute(positions, *payload_arrays)``
+([DRIVER] spec in BASELINE.json north_star; reference mount empty, SURVEY.md
+§0) — with the mandated ``backend={'jax', 'numpy'}`` switch: ``'jax'`` runs
+the SPMD pipeline on the device mesh; ``'numpy'`` runs the bit-level
+rank-simulation oracle with identical padded layout and capacity semantics
+(the stand-in for the reference's mpi4py oracle path, which needs mpi4py —
+absent here, SURVEY.md §4).
+
+Global data layout (both backends):
+  * ``pos``:   ``[R * n_local, ndim]`` — shard r owns rows
+    ``[r*n_local, (r+1)*n_local)``; only the first ``count[r]`` are valid.
+  * ``count``: ``[R]`` int32 valid-row counts (``None`` = all rows valid).
+  * fields:    any number of ``[R * n_local, ...]`` arrays riding the same
+    permutation (SURVEY.md C7).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu import oracle
+from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
+
+
+class RedistributeResult(NamedTuple):
+    """Outcome of one redistribute: padded arrays + counts + stats."""
+
+    positions: object
+    fields: Tuple
+    count: object
+    stats: object
+
+
+def _as_domain(domain, lo=None, hi=None, periodic=False) -> Domain:
+    if isinstance(domain, Domain):
+        return domain
+    if domain is None:
+        return Domain(lo, hi, periodic)
+    raise TypeError(f"domain must be a Domain, got {type(domain)}")
+
+
+class GridRedistribute:
+    """Spatial particle redistribution over a Cartesian grid of shards.
+
+    Args:
+      domain: :class:`Domain` (or pass ``lo``/``hi``/``periodic``).
+      grid: :class:`ProcessGrid` or a grid-shape tuple like ``(2, 2, 2)``.
+      backend: ``'jax'`` (device mesh) or ``'numpy'`` (oracle simulation).
+      mesh: optional prebuilt ``jax.sharding.Mesh``; built from
+        ``jax.devices()`` when omitted (jax backend only).
+      capacity: slots per *remote* (source, dest) pair in the padded
+        all-to-all (self-owned rows bypass the wire and are never clipped);
+        default ``ceil(n_local / R * capacity_factor)`` at call time.
+      capacity_factor: headroom multiplier for the default capacity
+        (SURVEY.md §7.6 load-imbalance tension; raise for clustered data).
+      out_capacity: padded rows per shard on output; default ``n_local``
+        (same layout as input, so drift loops iterate with static shapes).
+    """
+
+    def __init__(
+        self,
+        domain: Domain = None,
+        grid=None,
+        *,
+        lo=None,
+        hi=None,
+        periodic=False,
+        backend: str = "jax",
+        mesh=None,
+        capacity: Optional[int] = None,
+        capacity_factor: float = 2.0,
+        out_capacity: Optional[int] = None,
+    ):
+        self.domain = _as_domain(domain, lo, hi, periodic)
+        if grid is None:
+            raise ValueError("grid (ProcessGrid or shape tuple) is required")
+        self.grid = (
+            grid if isinstance(grid, ProcessGrid) else ProcessGrid(tuple(grid))
+        )
+        self.grid.validate_against(self.domain)
+        if backend not in ("jax", "numpy"):
+            raise ValueError(f"backend must be 'jax' or 'numpy', got {backend!r}")
+        self.backend = backend
+        for name, v in (("capacity", capacity), ("out_capacity", out_capacity)):
+            if v is not None and int(v) < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        self.capacity = capacity
+        self.capacity_factor = float(capacity_factor)
+        self.out_capacity = out_capacity
+        self._mesh = mesh
+        if backend == "jax" and mesh is not None:
+            mesh_lib.validate_mesh_for_grid(mesh, self.grid)
+
+    @property
+    def nranks(self) -> int:
+        return self.grid.nranks
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = mesh_lib.make_mesh(self.grid)
+        return self._mesh
+
+    def _capacities(self, n_local: int) -> Tuple[int, int]:
+        cap = self.capacity
+        if cap is None:
+            cap = max(1, math.ceil(n_local / self.nranks * self.capacity_factor))
+        cap = min(cap, n_local)  # can never send more than n_local to one dest
+        out_cap = n_local if self.out_capacity is None else self.out_capacity
+        return cap, out_cap
+
+    def _check_inputs(self, pos, fields, count):
+        R = self.nranks
+        if pos.ndim != 2 or pos.shape[1] != self.domain.ndim:
+            raise ValueError(
+                f"positions must be [R*n_local, {self.domain.ndim}], "
+                f"got {pos.shape}"
+            )
+        if pos.shape[0] % R:
+            raise ValueError(
+                f"global rows {pos.shape[0]} must divide evenly over "
+                f"{R} ranks"
+            )
+        n_local = pos.shape[0] // R
+        for i, f in enumerate(fields):
+            if f.shape[0] != pos.shape[0]:
+                raise ValueError(
+                    f"field {i} leading dim {f.shape[0]} != {pos.shape[0]}"
+                )
+        if count is None:
+            count = np.full((R,), n_local, dtype=np.int32)
+        count_host = np.asarray(count, dtype=np.int32)
+        if count_host.shape != (R,):
+            raise ValueError(f"count must be [{R}], got {count_host.shape}")
+        if (count_host < 0).any() or (count_host > n_local).any():
+            raise ValueError(
+                f"count entries must be in [0, {n_local}], got {count_host}"
+            )
+        count = (
+            jnp.asarray(count_host)
+            if self.backend == "jax"
+            else count_host
+        )
+        return n_local, count
+
+    def redistribute(self, positions, *fields, count=None) -> RedistributeResult:
+        """Bin, pack, exchange: every particle moves to its owner shard.
+
+        Returns a :class:`RedistributeResult` in the same global padded
+        layout (leading dim ``R * out_capacity``).
+        """
+        n_local, count = self._check_inputs(positions, fields, count)
+        cap, out_cap = self._capacities(n_local)
+        if self.backend == "numpy":
+            pos_out, counts_out, fields_out, stats = (
+                oracle.redistribute_oracle_padded(
+                    self.domain,
+                    self.grid,
+                    np.asarray(positions),
+                    np.asarray(count),
+                    [np.asarray(f) for f in fields],
+                    cap,
+                    out_cap,
+                )
+            )
+            return RedistributeResult(
+                pos_out,
+                tuple(fields_out),
+                counts_out,
+                exchange.RedistributeStats(**stats),
+            )
+        fn = exchange.build_redistribute(
+            self.mesh, self.domain, self.grid, cap, out_cap, len(fields)
+        )
+        out = fn(positions, count, *fields)
+        pos_out, count_out = out[0], out[1]
+        fields_out = tuple(out[2:-1])
+        stats = out[-1]
+        return RedistributeResult(pos_out, fields_out, count_out, stats)
+
+    __call__ = redistribute
+
+
+def redistribute(
+    positions,
+    *fields,
+    domain: Domain,
+    grid,
+    count=None,
+    backend: str = "jax",
+    **kwargs,
+) -> RedistributeResult:
+    """One-shot functional form of :class:`GridRedistribute`."""
+    rd = GridRedistribute(domain, grid, backend=backend, **kwargs)
+    return rd.redistribute(positions, *fields, count=count)

@@ -1,0 +1,1 @@
+"""Stage-level kernels: binning, packing, compaction, deposit."""

@@ -1,0 +1,87 @@
+"""Position -> cell -> destination-rank binning (SURVEY.md C2, C3, C9).
+
+The reference's hot-path front end ("position->cell digitize + per-destination
+histogram", SURVEY.md §3.2 — reference mount empty, spec from BASELINE.json
+north_star) mapped to TPU-friendly primitives: pure elementwise floor-divide
+binning (vectorizes trivially; no data-dependent shapes) and a
+``segment_sum`` histogram that XLA lowers to an efficient scatter-add.
+
+Every function takes an ``xp`` module argument (``jax.numpy`` or ``numpy``) so
+the JAX device path and the pure-NumPy oracle backend execute *the same
+code* — semantic drift between backend and oracle is structurally impossible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+
+
+def wrap_periodic(pos, domain: Domain, xp=jnp):
+    """Wrap positions into [lo, hi) along the domain's periodic axes.
+
+    Non-periodic axes pass through unchanged (out-of-box particles on those
+    axes are clamped into edge cells by ``cell_of_position``).
+    """
+    lo = xp.asarray(domain.lo, dtype=pos.dtype)
+    extent = xp.asarray(domain.extent, dtype=pos.dtype)
+    wrapped = lo + xp.remainder(pos - lo, extent)
+    # remainder can round up to exactly `extent` for tiny negative inputs in
+    # float32; fold that back to lo.
+    wrapped = xp.where(wrapped >= lo + extent, lo, wrapped)
+    per = xp.asarray(domain.periodic, dtype=bool)
+    return xp.where(per, wrapped, pos)
+
+
+def cell_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
+    """Map positions [N, ndim] to integer grid-cell coordinates [N, ndim].
+
+    Uniform cells: ``cell = floor((pos - lo) * grid_shape / extent)``, clamped
+    into [0, shape-1] so particles exactly at (or numerically beyond) the
+    upper edge land in the last cell rather than out of range.
+    """
+    lo = xp.asarray(domain.lo, dtype=pos.dtype)
+    inv_width = xp.asarray(
+        [s / e for s, e in zip(grid.shape, domain.extent)], dtype=pos.dtype
+    )
+    cell = xp.floor((pos - lo) * inv_width).astype(xp.int32)
+    hi_cell = xp.asarray([s - 1 for s in grid.shape], dtype=xp.int32)
+    return xp.clip(cell, 0, hi_cell)
+
+
+def rank_of_cell(cell, grid: ProcessGrid, xp=jnp):
+    """Flat row-major destination rank [N] from cell coordinates [N, ndim]."""
+    strides = xp.asarray(grid.strides, dtype=xp.int32)
+    return xp.sum(cell * strides, axis=-1).astype(xp.int32)
+
+
+def rank_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
+    """Fused wrap -> digitize -> cell->rank map: destination rank per particle."""
+    pos = wrap_periodic(pos, domain, xp=xp)
+    return rank_of_cell(cell_of_position(pos, domain, grid, xp=xp), grid, xp=xp)
+
+
+def dest_histogram(dest, nranks: int, valid=None):
+    """Per-destination send counts [nranks] (int32), JAX path.
+
+    ``dest`` may contain the sentinel value ``nranks`` for invalid (padding)
+    rows; those fall in an extra trash segment that is sliced off.
+    """
+    weights = jnp.ones(dest.shape, dtype=jnp.int32)
+    if valid is not None:
+        weights = weights * valid.astype(jnp.int32)
+    seg = jax.ops.segment_sum(weights, dest, num_segments=nranks + 1)
+    return seg[:nranks]
+
+
+def dest_histogram_np(dest, nranks: int, valid=None):
+    """NumPy twin of ``dest_histogram`` for the oracle backend."""
+    weights = np.ones(dest.shape, dtype=np.int64)
+    if valid is not None:
+        weights = weights * valid.astype(np.int64)
+    return np.bincount(dest, weights=weights, minlength=nranks + 1)[
+        :nranks
+    ].astype(np.int32)

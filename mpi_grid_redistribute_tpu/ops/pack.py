@@ -1,0 +1,184 @@
+"""Sort-by-destination pack and receive-side compaction (SURVEY.md C4, C6).
+
+The reference packs send buffers with a stable argsort on destination rank
+and unpacks Alltoallv receive buffers that are contiguous-by-source
+(SURVEY.md §3.2 — mount empty, spec from BASELINE.json north_star: "the
+sort-by-destination permutation becomes jax.lax.sort on packed (dest_rank,
+local_idx) keys"). MPI's Alltoallv is variable-size; XLA's ``all_to_all`` is
+static-shape, so this module realizes the MoE-dispatch-style bridge
+(SURVEY.md §7.3): every (source, destination) pair gets a fixed ``capacity``
+of slots, rows are gathered into a ``[R, capacity, ...]`` layout, unused
+slots are zero-masked, and overflow beyond capacity is *counted and
+surfaced*, never silently dropped.
+
+All shapes are static; nothing here depends on data values, so everything
+jits and shards cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_rows(a: jax.Array, mask: jax.Array) -> jax.Array:
+    """Zero out rows of ``a`` where ``mask`` (matching leading dims) is False."""
+    extra = a.ndim - mask.ndim
+    return jnp.where(mask.reshape(mask.shape + (1,) * extra), a, 0)
+
+
+def _take_rows(order: jax.Array, out_capacity: int) -> jax.Array:
+    """First ``out_capacity`` entries of ``order``, zero-padded if the slot
+    pool is smaller than the requested output (padding rows are masked by the
+    caller's validity mask)."""
+    take = order[:out_capacity]
+    if take.shape[0] < out_capacity:
+        take = jnp.concatenate(
+            [take, jnp.zeros((out_capacity - take.shape[0],), take.dtype)]
+        )
+    return take
+
+
+def pack_by_destination(
+    dest: jax.Array,
+    counts: jax.Array,
+    arrays,
+    capacity: int,
+):
+    """Gather per-particle arrays into a ``[R, capacity, ...]`` send layout.
+
+    Args:
+      dest: [N] int32 destination rank per row; rows with the sentinel value
+        ``R`` (invalid padding) sort to the end and are never gathered.
+      counts: [R] int32 **full** (unclipped) per-destination counts — these
+        locate each destination's segment in the sorted order; slots beyond
+        ``min(counts[r], capacity)`` are zero-masked, so overflow keeps the
+        stable prefix per destination.
+      arrays: pytree of [N, ...] arrays sharing the leading axis.
+      capacity: static slots per destination.
+
+    Returns:
+      pytree of [R, capacity, ...] arrays, zero in invalid slots.
+    """
+    R = counts.shape[0]
+    n = dest.shape[0]
+    order = jnp.argsort(dest, stable=True)  # invalid (dest==R) land last
+    start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
+    )
+    c_idx = jnp.arange(capacity, dtype=jnp.int32)
+    src_sorted = start[:, None] + c_idx[None, :]  # [R, C] index into sorted
+    slot_valid = c_idx[None, :] < jnp.minimum(counts, capacity)[:, None]
+    src_sorted = jnp.minimum(src_sorted, n - 1)
+    gather_idx = order[src_sorted]  # [R, C] index into original rows
+    return jax.tree.map(
+        lambda a: _mask_rows(jnp.take(a, gather_idx, axis=0), slot_valid),
+        arrays,
+    )
+
+
+def _stable_order(invalid: jax.Array, *subkeys: jax.Array) -> jax.Array:
+    """Permutation putting valid rows first, ordered by ``subkeys`` then by
+    original position (stable). Multi-operand ``lax.sort`` keeps every key in
+    int32 — no fused ``s * K + c`` key that could overflow at scale."""
+    m = invalid.shape[0]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    operands = (invalid.astype(jnp.int32),) + subkeys + (iota,)
+    out = jax.lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
+    return out[-1]
+
+
+def _finish_compact(values, order, new_count_full, out_capacity: int):
+    """Shared compaction tail: gather the first ``out_capacity`` rows of the
+    ordered pool, zero the invalid tail, report count + overflow."""
+    dropped = jnp.maximum(new_count_full - out_capacity, 0)
+    new_count = jnp.minimum(new_count_full, out_capacity)
+    take = _take_rows(order, out_capacity)
+    row_valid = jnp.arange(out_capacity, dtype=jnp.int32) < new_count
+    out = jax.tree.map(
+        lambda a: _mask_rows(jnp.take(a, take, axis=0), row_valid), values
+    )
+    return out, new_count.astype(jnp.int32), dropped.astype(jnp.int32)
+
+
+def compact_with_self(
+    recv,
+    recv_counts: jax.Array,
+    local,
+    self_mask: jax.Array,
+    me: jax.Array,
+    out_capacity: int,
+):
+    """Merge remote receives with locally-retained rows, Alltoallv-ordered.
+
+    Rows already owned by this shard never ride the wire (SURVEY.md §7.3 —
+    in a drift loop most particles stay put each step, so capacity only needs
+    to cover *migrants*); they are spliced back here at source position
+    ``me`` so the output is still exactly MPI Alltoallv receive order
+    (source-major, stable within source) and bit-comparable to the oracle.
+
+    Args:
+      recv: pytree of [R, capacity, ...] remote receive buffers
+        (row ``me`` is all-zero: nothing is sent to self).
+      recv_counts: [R] int32 valid rows per source (``recv_counts[me] == 0``).
+      local: pytree of [n, ...] — the *original* per-shard arrays.
+      self_mask: [n] bool — rows of ``local`` this shard keeps.
+      me: scalar int32 — this shard's rank (``lax.axis_index``).
+      out_capacity: static output rows.
+
+    Returns:
+      (pytree of [out_capacity, ...], new_count, dropped) like
+      :func:`compact_received`.
+    """
+    first = jax.tree.leaves(recv)[0]
+    R, capacity = first.shape[0], first.shape[1]
+    n = jax.tree.leaves(local)[0].shape[0]
+    c_idx = jnp.arange(capacity, dtype=jnp.int32)
+    valid_r = (c_idx[None, :] < recv_counts[:, None]).reshape(R * capacity)
+    # Source rank per pooled row: s for remote slot (s, c), `me` for local
+    # rows. No valid collision within a source: recv_counts[me] == 0, so
+    # the stable iota tiebreak fully orders rows within each source.
+    src_r = jnp.broadcast_to(
+        jnp.arange(R, dtype=jnp.int32)[:, None], (R, capacity)
+    ).reshape(R * capacity)
+    src_s = jnp.full((n,), me, dtype=jnp.int32)
+    invalid = ~jnp.concatenate([valid_r, self_mask])
+    source_key = jnp.concatenate([src_r, src_s])
+    order = _stable_order(invalid, source_key)
+    values = jax.tree.map(
+        lambda a, b: jnp.concatenate(
+            [a.reshape((R * capacity,) + a.shape[2:]), b], axis=0
+        ),
+        recv,
+        local,
+    )
+    new_count_full = jnp.sum(recv_counts) + jnp.sum(self_mask.astype(jnp.int32))
+    return _finish_compact(values, order, new_count_full, out_capacity)
+
+
+def compact_received(
+    recv,
+    recv_counts: jax.Array,
+    out_capacity: int,
+):
+    """Compact a ``[R, capacity, ...]`` receive layout into ``[out_capacity, ...]``.
+
+    Valid rows are kept in **source-major, stable** order — exactly MPI
+    Alltoallv's receive ordering (SURVEY.md §7.4's canonical order), so the
+    result is bit-comparable to the oracle backend.
+
+    Returns:
+      (pytree of [out_capacity, ...], new_count int32 scalar,
+       dropped int32 scalar — rows beyond out_capacity).
+    """
+    first = jax.tree.leaves(recv)[0]
+    R, capacity = first.shape[0], first.shape[1]
+    total = R * capacity
+    c_idx = jnp.arange(capacity, dtype=jnp.int32)
+    valid = (c_idx[None, :] < recv_counts[:, None]).reshape(total)
+    # Stable compaction: valid rows keep their flat (source-major) order.
+    order = _stable_order(~valid)
+    values = jax.tree.map(lambda a: a.reshape((total,) + a.shape[2:]), recv)
+    return _finish_compact(values, order, jnp.sum(recv_counts), out_capacity)

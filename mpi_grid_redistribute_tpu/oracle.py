@@ -1,0 +1,180 @@
+"""Pure-NumPy multi-rank redistribution oracle (SURVEY.md §4, §7.4).
+
+The reference uses its mpi4py path as the bit-level correctness oracle
+(BASELINE.json north_star: "The mpi4py path stays as the bit-level
+correctness oracle"). mpi4py is not installed in this environment and there
+is no network (SURVEY.md §0/[ENV]), so this module *simulates* R MPI ranks in
+one process with exactly MPI ``Alltoallv`` receive-ordering semantics:
+
+  * each rank's receive buffer is the concatenation over **source ranks in
+    ascending order** of the particles that source sent it;
+  * within one source rank, particles keep their **stable original order**
+    (the reference packs with a stable sort-by-destination, SURVEY.md C4).
+
+By construction this is bit-identical to what an mpi4py
+``Alltoall``+``Alltoallv`` round would produce, so the JAX/TPU backend is
+tested against it at bit level. If real mpi4py ever becomes available,
+``tests/test_oracle_mpi4py.py`` cross-checks this simulation against it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning
+
+
+def redistribute_oracle(
+    domain: Domain,
+    grid: ProcessGrid,
+    pos_shards: Sequence[np.ndarray],
+    field_shards: Sequence[Sequence[np.ndarray]] = (),
+) -> Tuple[List[np.ndarray], List[List[np.ndarray]], np.ndarray]:
+    """Simulate a full R-rank redistribute on the host.
+
+    Args:
+      domain: global domain.
+      grid: process grid; ``grid.nranks`` must equal ``len(pos_shards)``.
+      pos_shards: per-rank position arrays ``[n_r, ndim]`` (ragged allowed).
+      field_shards: per-rank tuples of payload arrays, each ``[n_r, ...]``
+        sharing the positions' leading axis.
+
+    Returns:
+      (recv_pos, recv_fields, counts_matrix) where ``recv_pos[r]`` is rank
+      r's received positions in Alltoallv order, ``recv_fields[r]`` the
+      payloads carried through the same permutation, and
+      ``counts_matrix[s, r]`` the number of particles sent s->r.
+    """
+    R = grid.nranks
+    if len(pos_shards) != R:
+        raise ValueError(f"expected {R} shards, got {len(pos_shards)}")
+    for r, fields in enumerate(field_shards):
+        for f in fields:
+            if f.shape[0] != pos_shards[r].shape[0]:
+                raise ValueError(
+                    f"rank {r}: field leading dim {f.shape[0]} != "
+                    f"{pos_shards[r].shape[0]} particles"
+                )
+
+    counts = np.zeros((R, R), dtype=np.int64)
+    # send_rows[s][d] = stable-order row indices on source s destined for d.
+    send_rows: List[List[np.ndarray]] = []
+    for s in range(R):
+        dest = binning.rank_of_position(
+            np.asarray(pos_shards[s]), domain, grid, xp=np
+        )
+        rows = [np.flatnonzero(dest == d) for d in range(R)]
+        send_rows.append(rows)
+        counts[s] = [len(idx) for idx in rows]
+
+    recv_pos: List[np.ndarray] = []
+    recv_fields: List[List[np.ndarray]] = []
+    nf = len(field_shards[0]) if field_shards else 0
+    for d in range(R):
+        pos_parts = [pos_shards[s][send_rows[s][d]] for s in range(R)]
+        recv_pos.append(np.concatenate(pos_parts, axis=0))
+        recv_fields.append(
+            [
+                np.concatenate(
+                    [field_shards[s][k][send_rows[s][d]] for s in range(R)],
+                    axis=0,
+                )
+                for k in range(nf)
+            ]
+        )
+    return recv_pos, recv_fields, counts
+
+
+def redistribute_oracle_padded(
+    domain: Domain,
+    grid: ProcessGrid,
+    pos: np.ndarray,
+    counts: np.ndarray,
+    fields: Sequence[np.ndarray],
+    capacity: int,
+    out_capacity: int,
+):
+    """Padded-layout oracle mirroring the JAX backend's exact semantics.
+
+    Takes the same *global padded* layout the sharded path uses
+    (``[R * n_local, ...]`` rows, ``counts[r]`` valid rows per shard) and
+    reproduces its capacity behavior bit-for-bit: per *remote* (source, dest)
+    pair only the first ``capacity`` particles (stable order) are sent, the
+    rest are counted in ``dropped_send`` (self-owned rows bypass the wire and
+    are never clipped); each receiver keeps the first
+    ``out_capacity`` rows of its Alltoallv-ordered receive stream and counts
+    the rest in ``dropped_recv``. Invalid/padding rows are zero.
+
+    Returns ``(pos_out, counts_out, fields_out, stats_dict)`` with
+    ``pos_out`` of shape ``[R * out_capacity, ...]``.
+    """
+    R = grid.nranks
+    n_local = pos.shape[0] // R
+    if pos.shape[0] != R * n_local:
+        raise ValueError(f"global rows {pos.shape[0]} not divisible by R={R}")
+    counts = np.asarray(counts, dtype=np.int64)
+
+    send_counts = np.zeros((R, R), dtype=np.int32)
+    dropped_send = np.zeros((R,), dtype=np.int32)
+    send_rows: List[List[np.ndarray]] = []
+    for s in range(R):
+        sl = slice(s * n_local, s * n_local + int(counts[s]))
+        dest = binning.rank_of_position(np.asarray(pos[sl]), domain, grid, xp=np)
+        rows = []
+        for d in range(R):
+            idx = np.flatnonzero(dest == d) + s * n_local
+            if d != s:
+                # capacity bounds remote pairs only; self-owned rows never
+                # ride the wire in the JAX backend (pack.compact_with_self)
+                # so they are never capacity-clipped.
+                dropped_send[s] += max(len(idx) - capacity, 0)
+                idx = idx[:capacity]
+            rows.append(idx)
+            send_counts[s, d] = len(idx)
+        # send_rows[s][d] = global row indices source s sends to dest d.
+        send_rows.append(rows)
+
+    counts_out = np.zeros((R,), dtype=np.int32)
+    dropped_recv = np.zeros((R,), dtype=np.int32)
+    pos_out = np.zeros((R * out_capacity,) + pos.shape[1:], dtype=pos.dtype)
+    fields_out = [
+        np.zeros((R * out_capacity,) + f.shape[1:], dtype=f.dtype)
+        for f in fields
+    ]
+    for d in range(R):
+        idx = np.concatenate([send_rows[s][d] for s in range(R)])
+        dropped_recv[d] = max(len(idx) - out_capacity, 0)
+        idx = idx[:out_capacity]
+        counts_out[d] = len(idx)
+        sl = slice(d * out_capacity, d * out_capacity + len(idx))
+        pos_out[sl] = pos[idx]
+        for k, f in enumerate(fields):
+            fields_out[k][sl] = f[idx]
+
+    stats = {
+        "send_counts": send_counts,
+        "recv_counts": send_counts.T.copy(),
+        "dropped_send": dropped_send,
+        "dropped_recv": dropped_recv,
+    }
+    return pos_out, counts_out, fields_out, stats
+
+
+def assert_ownership(
+    domain: Domain, grid: ProcessGrid, pos_shards: Sequence[np.ndarray]
+) -> None:
+    """Reference-style validation (SURVEY.md §3.5): every particle a rank
+    holds lies inside that rank's subdomain (after periodic wrap)."""
+    for r, pos in enumerate(pos_shards):
+        if len(pos) == 0:
+            continue
+        dest = binning.rank_of_position(np.asarray(pos), domain, grid, xp=np)
+        bad = np.flatnonzero(dest != r)
+        if bad.size:
+            raise AssertionError(
+                f"rank {r}: {bad.size} particles outside subdomain, e.g. "
+                f"{np.asarray(pos)[bad[0]]} -> rank {dest[bad[0]]}"
+            )

@@ -1,0 +1,1 @@
+"""Mesh construction, collective exchange, halo passes."""

@@ -1,0 +1,129 @@
+"""The sharded redistribute hot path (SURVEY.md §3.2, §7.3; C5, C6, C7).
+
+Where the reference crosses the process boundary twice — ``comm.Alltoall``
+for counts and ``comm.Alltoallv`` for payloads (SURVEY.md §3.2, [DRIVER]) —
+this module runs the whole pipeline as one SPMD program under ``shard_map``
+on a Cartesian device mesh:
+
+    digitize -> segment_sum histogram -> stable sort-by-destination pack
+    -> ``lax.all_to_all`` (counts) -> ``lax.all_to_all`` (payload pytree)
+    -> stable compaction to Alltoallv receive order
+
+Everything is static-shape (capacity-padded, SURVEY.md §7.6 "variable->fixed
+size gap") so XLA compiles a single fused program per (N, capacity) bucket
+and the collectives ride ICI. Overflow past capacity is counted and
+returned in the stats pytree, never silent (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning, pack
+
+
+class RedistributeStats(NamedTuple):
+    """Per-step observability (SURVEY.md §5.5). Global (post-shard_map)
+    shapes: ``send_counts`` is [R, R] indexed [source, dest];
+    ``recv_counts`` is its transpose, [dest, source] (row r = what rank r
+    received from each source); drop counters are [R]."""
+
+    send_counts: jax.Array
+    recv_counts: jax.Array
+    dropped_send: jax.Array
+    dropped_recv: jax.Array
+
+
+def shard_redistribute_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+):
+    """Build the per-shard function (runs under ``shard_map``).
+
+    Signature of the returned fn: ``(pos[N,D], count[1] int32, *fields)`` ->
+    ``(pos_out[out_capacity,D], count_out[1], fields_out..., stats)``.
+    """
+    R = grid.nranks
+    axes = grid.axis_names
+
+    def fn(pos, count, *fields):
+        n = pos.shape[0]
+        me = lax.axis_index(axes).astype(jnp.int32)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        valid = iota < count[0]
+        dest = binning.rank_of_position(pos, domain, grid)
+        dest = jnp.where(valid, dest, R).astype(jnp.int32)
+        # Self-owned rows stay local (never hit the wire); the sentinel R
+        # routes both invalid and self rows out of the remote pack.
+        is_self = valid & (dest == me)
+        dest_remote = jnp.where(is_self, R, dest)
+        remote_counts = binning.dest_histogram(dest_remote, R)
+        dropped_send = jnp.sum(jnp.maximum(remote_counts - capacity, 0))
+        send_counts = jnp.minimum(remote_counts, capacity)
+
+        arrays = (pos,) + tuple(fields)
+        packed = pack.pack_by_destination(
+            dest_remote, remote_counts, arrays, capacity
+        )
+        recv_counts = lax.all_to_all(
+            send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+        )
+        recv = jax.tree.map(
+            lambda a: lax.all_to_all(
+                a, axes, split_axis=0, concat_axis=0, tiled=True
+            ),
+            packed,
+        )
+        out, new_count, dropped_recv = pack.compact_with_self(
+            recv, recv_counts, arrays, is_self, me, out_capacity
+        )
+        self_count = jnp.sum(is_self.astype(jnp.int32))
+        self_onehot = (jnp.arange(R, dtype=jnp.int32) == me) * self_count
+        stats = RedistributeStats(
+            send_counts=(send_counts + self_onehot)[None, :],
+            recv_counts=(recv_counts + self_onehot)[None, :],
+            dropped_send=dropped_send[None].astype(jnp.int32),
+            dropped_recv=dropped_recv[None],
+        )
+        return (out[0], new_count[None]) + tuple(out[1:]) + (stats,)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    n_fields: int,
+):
+    """jit-compiled global redistribute over ``mesh``.
+
+    Global layout: ``pos`` is ``[R * n_local, D]`` sharded on axis 0 over all
+    mesh axes (x-major, matching rank order); ``count`` is ``[R]`` int32 with
+    one entry per shard. Returns the same layout with leading dim
+    ``R * out_capacity`` plus a :class:`RedistributeStats`.
+    """
+    axes = grid.axis_names
+    spec = P(axes)
+    fn = shard_redistribute_fn(domain, grid, capacity, out_capacity)
+    in_specs = (spec, spec) + (spec,) * n_fields
+    out_specs = (
+        (spec, spec)
+        + (spec,) * n_fields
+        + (RedistributeStats(spec, spec, spec, spec),)
+    )
+    sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(sharded)

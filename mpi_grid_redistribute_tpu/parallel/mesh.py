@@ -1,0 +1,65 @@
+"""Device-mesh construction mirroring the Cartesian process grid.
+
+The reference's process topology is an MPI Cartesian communicator
+(SURVEY.md C1/§2 — mount empty, [DRIVER] spec); the TPU-native equivalent is
+a ``jax.sharding.Mesh`` whose axes are the grid axes, so rank r of the grid
+*is* device r of the mesh and XLA's ``all_to_all`` over the flattened mesh
+axes reproduces the MPI rank ordering (row-major, x-major first).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from mpi_grid_redistribute_tpu.domain import ProcessGrid
+
+
+def make_mesh(grid: ProcessGrid, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh shaped like ``grid`` from ``devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    need = grid.nranks
+    if len(devices) < need:
+        raise ValueError(
+            f"grid {grid.shape} needs {need} devices, only "
+            f"{len(devices)} available"
+        )
+    arr = np.asarray(devices[:need], dtype=object).reshape(grid.shape)
+    return Mesh(arr, grid.axis_names)
+
+
+def near_cubic_shape(n: int, ndim: int = 3) -> Tuple[int, ...]:
+    """Factor ``n`` ranks into an ``ndim``-axis grid as close to cubic as
+    possible (largest prime factors spread round-robin). Used when the user
+    gives a device count instead of an explicit grid shape."""
+    if n < 1:
+        raise ValueError("need at least one rank")
+    factors = []
+    m = n
+    d = 2
+    while d * d <= m:
+        while m % d == 0:
+            factors.append(d)
+            m //= d
+        d += 1
+    if m > 1:
+        factors.append(m)
+    shape = [1] * ndim
+    for f in sorted(factors, reverse=True):
+        shape[int(np.argmin(shape))] *= f
+    return tuple(sorted(shape, reverse=True))
+
+
+def validate_mesh_for_grid(mesh: Mesh, grid: ProcessGrid) -> None:
+    if tuple(mesh.axis_names) != tuple(grid.axis_names):
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} != grid axes {grid.axis_names}"
+        )
+    mesh_shape = tuple(mesh.devices.shape)
+    if mesh_shape != grid.shape:
+        raise ValueError(f"mesh shape {mesh_shape} != grid shape {grid.shape}")

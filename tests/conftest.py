@@ -1,0 +1,35 @@
+"""Test fixtures: force an 8-device virtual CPU mesh (SURVEY.md §4).
+
+Only one physical TPU chip is visible in this environment, so all
+multi-device mesh logic is exercised on XLA's virtual host devices. The
+sitecustomize hook force-registers the experimental ``axon`` TPU platform at
+interpreter start, but backend selection is lazy — flipping
+``jax_platforms`` here (before any computation) wins.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,149 @@
+"""End-to-end: JAX mesh backend vs pure-NumPy oracle, bit-level (SURVEY.md §7.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu import (
+    Domain,
+    GridRedistribute,
+    ProcessGrid,
+    redistribute,
+)
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+DOMAIN = Domain(0.0, 1.0)
+
+
+def _inputs(rng, R=8, n_local=400, clustered=False):
+    n = R * n_local
+    if clustered:
+        pos = rng.lognormal(mean=-1.5, sigma=0.5, size=(n, 3)) % 1.0
+        pos = pos.astype(np.float32)
+    else:
+        pos = rng.uniform(0, 1, size=(n, 3)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int32)
+    vel = rng.normal(size=(n, 3)).astype(np.float32)
+    return pos, ids, vel
+
+
+def _compare(jax_res, np_res):
+    np.testing.assert_array_equal(np.asarray(jax_res.count), np_res.count)
+    np.testing.assert_array_equal(np.asarray(jax_res.positions), np_res.positions)
+    for fj, fn in zip(jax_res.fields, np_res.fields):
+        np.testing.assert_array_equal(np.asarray(fj), fn)
+    # stats is the same NamedTuple type for both backends
+    for a, b in zip(jax_res.stats, np_res.stats):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("grid_shape", [(2, 2, 2), (4, 2, 1), (8, 1, 1)])
+def test_jax_matches_oracle_bitlevel(rng, grid_shape):
+    pos, ids, vel = _inputs(rng)
+    kw = dict(domain=DOMAIN, grid=grid_shape, capacity_factor=3.0)
+    res_j = redistribute(pos, ids, vel, backend="jax", **kw)
+    res_n = redistribute(pos, ids, vel, backend="numpy", **kw)
+    _compare(res_j, res_n)
+    assert int(np.asarray(res_j.stats.dropped_send).sum()) == 0
+
+
+def test_conservation_and_ownership(rng):
+    from mpi_grid_redistribute_tpu import oracle
+
+    pos, ids, _ = _inputs(rng)
+    rd = GridRedistribute(
+        DOMAIN, (2, 2, 2), backend="jax", capacity_factor=3.0, out_capacity=800
+    )
+    res = rd.redistribute(pos, ids)
+    counts = np.asarray(res.count)
+    assert counts.sum() == pos.shape[0]
+    out_cap = res.positions.shape[0] // rd.nranks
+    shards = [
+        np.asarray(res.positions)[r * out_cap : r * out_cap + counts[r]]
+        for r in range(rd.nranks)
+    ]
+    oracle.assert_ownership(DOMAIN, rd.grid, shards)
+    got_ids = np.concatenate(
+        [
+            np.asarray(res.fields[0])[r * out_cap : r * out_cap + counts[r]]
+            for r in range(rd.nranks)
+        ]
+    )
+    np.testing.assert_array_equal(np.sort(got_ids), np.sort(ids))
+
+
+def test_idempotence(rng):
+    pos, _, _ = _inputs(rng)
+    rd = GridRedistribute(DOMAIN, (2, 2, 2), backend="jax", capacity_factor=3.0)
+    res1 = rd.redistribute(pos)
+    res2 = rd.redistribute(res1.positions, count=res1.count)
+    np.testing.assert_array_equal(np.asarray(res1.count), np.asarray(res2.count))
+    np.testing.assert_array_equal(
+        np.asarray(res1.positions), np.asarray(res2.positions)
+    )
+
+
+def test_clustered_overflow_surfaces(rng):
+    pos, ids, _ = _inputs(rng, clustered=True)
+    kw = dict(domain=DOMAIN, grid=(2, 2, 2), capacity=60)
+    res_j = redistribute(pos, ids, backend="jax", **kw)
+    res_n = redistribute(pos, ids, backend="numpy", **kw)
+    _compare(res_j, res_n)
+    assert int(np.asarray(res_j.stats.dropped_send).sum()) > 0
+
+
+def test_periodic_domain(rng):
+    dom = Domain(0.0, 1.0, periodic=True)
+    pos, _, _ = _inputs(rng)
+    pos = pos + np.float32(1.75)  # everything out of the box; wraps back
+    kw = dict(domain=dom, grid=(2, 2, 2), capacity_factor=3.0, out_capacity=800)
+    res_j = redistribute(pos, backend="jax", **kw)
+    res_n = redistribute(pos, backend="numpy", **kw)
+    _compare(res_j, res_n)
+    assert int(np.asarray(res_j.count).sum()) == pos.shape[0]
+
+
+def test_ragged_counts(rng):
+    pos, ids, _ = _inputs(rng, n_local=100)
+    count = np.asarray(rng.integers(0, 101, size=8), dtype=np.int32)
+    kw = dict(domain=DOMAIN, grid=(2, 2, 2), capacity_factor=3.0)
+    res_j = redistribute(pos, ids, count=count, backend="jax", **kw)
+    res_n = redistribute(pos, ids, count=count, backend="numpy", **kw)
+    _compare(res_j, res_n)
+    assert int(np.asarray(res_j.count).sum()) == count.sum()
+
+
+def test_single_rank_grid(rng):
+    pos, _, _ = _inputs(rng, R=1, n_local=50)
+    res = redistribute(pos, domain=DOMAIN, grid=(1, 1, 1), backend="jax")
+    assert int(np.asarray(res.count)[0]) == 50
+    np.testing.assert_array_equal(np.asarray(res.positions), pos)
+
+
+def test_input_validation(rng):
+    rd = GridRedistribute(DOMAIN, (2, 2, 2))
+    with pytest.raises(ValueError):
+        rd.redistribute(np.zeros((10, 3), np.float32))  # not divisible by 8
+    with pytest.raises(ValueError):
+        rd.redistribute(np.zeros((16, 2), np.float32))  # wrong ndim
+    with pytest.raises(ValueError):
+        GridRedistribute(DOMAIN, (2, 2, 2), backend="mpi")
+    with pytest.raises(ValueError):  # count out of range
+        rd.redistribute(
+            np.zeros((16, 3), np.float32), count=np.full(8, 3, np.int32)
+        )
+    with pytest.raises(ValueError):  # negative count
+        rd.redistribute(
+            np.zeros((16, 3), np.float32), count=np.full(8, -1, np.int32)
+        )
+    with pytest.raises(ValueError):  # zero out_capacity is rejected, not unset
+        GridRedistribute(DOMAIN, (2, 2, 2), out_capacity=0)
+
+
+def test_near_cubic_shape():
+    assert mesh_lib.near_cubic_shape(8) == (2, 2, 2)
+    assert mesh_lib.near_cubic_shape(64) == (4, 4, 4)
+    assert mesh_lib.near_cubic_shape(16) == (4, 2, 2)
+    assert mesh_lib.near_cubic_shape(1) == (1, 1, 1)
+    assert mesh_lib.near_cubic_shape(12, ndim=2) == (4, 3)

@@ -1,0 +1,75 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning
+
+DOMAIN = Domain((0.0, 0.0, 0.0), (1.0, 2.0, 4.0))
+GRID = ProcessGrid((2, 2, 2))
+
+
+def test_cell_of_position_jax_numpy_agree(rng):
+    pos = rng.uniform(0, 1, size=(5000, 3)).astype(np.float32) * np.array(
+        [1.0, 2.0, 4.0], dtype=np.float32
+    )
+    c_np = binning.cell_of_position(pos, DOMAIN, GRID, xp=np)
+    c_jx = binning.cell_of_position(jnp.asarray(pos), DOMAIN, GRID)
+    np.testing.assert_array_equal(c_np, np.asarray(c_jx))
+
+
+def test_edges_clamp_into_grid():
+    pos = np.array(
+        [
+            [0.0, 0.0, 0.0],
+            [1.0, 2.0, 4.0],       # exactly hi -> last cell
+            [-0.1, 2.5, 4.0001],   # outside, non-periodic -> clamped
+        ],
+        dtype=np.float32,
+    )
+    c = binning.cell_of_position(pos, DOMAIN, GRID, xp=np)
+    assert c.min() >= 0 and (c < np.array(GRID.shape)).all()
+    np.testing.assert_array_equal(c[1], [1, 1, 1])
+    np.testing.assert_array_equal(c[2], [0, 1, 1])
+
+
+def test_periodic_wrap():
+    dom = Domain((0.0, 0.0, 0.0), (1.0, 1.0, 1.0), periodic=True)
+    pos = np.array([[1.25, -0.25, 3.5]], dtype=np.float32)
+    w = binning.wrap_periodic(pos, dom, xp=np)
+    np.testing.assert_allclose(w, [[0.25, 0.75, 0.5]], atol=1e-6)
+    # mixed: only axis 0 periodic
+    dom2 = Domain((0.0, 0.0, 0.0), (1.0, 1.0, 1.0), periodic=(True, False, False))
+    w2 = binning.wrap_periodic(pos, dom2, xp=np)
+    np.testing.assert_allclose(w2, [[0.25, -0.25, 3.5]], atol=1e-6)
+
+
+def test_periodic_wrap_tiny_negative_float32():
+    dom = Domain(0.0, 1.0, periodic=True)
+    pos = np.full((1, 3), -1e-9, dtype=np.float32)
+    w = binning.wrap_periodic(pos, dom, xp=np)
+    assert (w < 1.0).all() and (w >= 0.0).all()
+    c = binning.cell_of_position(w, dom, ProcessGrid((2, 2, 2)), xp=np)
+    assert (c >= 0).all() and (c <= 1).all()
+
+
+def test_rank_of_position_rowmajor():
+    pos = np.array([[0.9, 1.9, 3.9]], dtype=np.float32)  # cell (1,1,1)
+    r = binning.rank_of_position(pos, DOMAIN, GRID, xp=np)
+    assert r[0] == 7
+
+
+def test_dest_histogram_matches_numpy(rng):
+    R = GRID.nranks
+    dest = rng.integers(0, R + 1, size=1000).astype(np.int32)  # incl sentinel
+    h_jx = binning.dest_histogram(jnp.asarray(dest), R)
+    h_np = binning.dest_histogram_np(dest, R)
+    np.testing.assert_array_equal(np.asarray(h_jx), h_np)
+    assert h_np.sum() == (dest < R).sum()
+
+
+def test_dest_histogram_valid_mask():
+    dest = np.array([0, 0, 1, 1, 1], dtype=np.int32)
+    valid = np.array([True, False, True, True, False])
+    h = binning.dest_histogram(jnp.asarray(dest), 2, valid=jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(h), [1, 2])

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+
+
+def test_domain_scalar_broadcast():
+    d = Domain(0.0, 1.0)
+    assert d.ndim == 3
+    assert d.lo == (0.0, 0.0, 0.0)
+    assert d.hi == (1.0, 1.0, 1.0)
+    assert d.periodic == (False, False, False)
+
+
+def test_domain_validation():
+    with pytest.raises(ValueError):
+        Domain((0, 0), (1, -1))
+    with pytest.raises(ValueError):
+        Domain((0, 0, 0), (1, 1, 1), periodic=(True,))
+
+
+def test_rank_cell_roundtrip():
+    g = ProcessGrid((2, 3, 4))
+    assert g.nranks == 24
+    seen = set()
+    for r in range(g.nranks):
+        cell = g.cell_of_rank(r)
+        assert g.rank_of_cell(cell) == r
+        seen.add(cell)
+    assert len(seen) == 24
+    # row-major: last axis fastest
+    assert g.rank_of_cell((0, 0, 1)) == 1
+    assert g.rank_of_cell((0, 1, 0)) == 4
+    assert g.rank_of_cell((1, 0, 0)) == 12
+
+
+def test_slab_grid_with_unit_axis():
+    g = ProcessGrid((4, 2, 1))
+    assert g.nranks == 8
+    assert g.cell_of_rank(7) == (3, 1, 0)
+
+
+def test_subdomain_bounds():
+    d = Domain((0.0, 0.0, 0.0), (8.0, 4.0, 2.0))
+    g = ProcessGrid((4, 2, 1))
+    lo, hi = g.subdomain_of_rank(0, d)
+    assert lo == (0.0, 0.0, 0.0) and hi == (2.0, 2.0, 2.0)
+    lo, hi = g.subdomain_of_rank(7, d)
+    assert lo == (6.0, 2.0, 0.0) and hi == (8.0, 4.0, 2.0)
+
+
+def test_neighbor_rank_periodic_and_edge():
+    g = ProcessGrid((2, 2, 2))
+    assert g.neighbor_rank(0, axis=0, step=1, periodic=False) == 4
+    assert g.neighbor_rank(4, axis=0, step=1, periodic=False) == -1
+    assert g.neighbor_rank(4, axis=0, step=1, periodic=True) == 0
+    assert g.neighbor_rank(0, axis=2, step=-1, periodic=True) == 1
+
+
+def test_grid_domain_ndim_mismatch():
+    with pytest.raises(ValueError):
+        ProcessGrid((2, 2)).validate_against(Domain(0.0, 1.0))

@@ -1,0 +1,74 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_grid_redistribute_tpu.ops import pack
+
+
+def test_pack_by_destination_layout():
+    dest = jnp.array([1, 0, 1, 2, 0, 3], dtype=jnp.int32)  # R=3 sentinel 3
+    counts = jnp.array([2, 2, 1], dtype=jnp.int32)
+    vals = jnp.arange(6, dtype=jnp.float32) * 10
+    out = pack.pack_by_destination(dest, counts, (vals,), capacity=3)[0]
+    # dest 0: rows 1,4 ; dest 1: rows 0,2 ; dest 2: row 3; rest zero-masked
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        [[10, 40, 0], [0, 20, 0], [30, 0, 0]],
+    )
+
+
+def test_pack_capacity_clip_keeps_stable_prefix():
+    # dest 0 overflows capacity; dest 1's segment must still be located by
+    # the FULL count of dest 0 (offset 3), not the clipped one.
+    dest = jnp.array([0, 0, 1, 0, 1], dtype=jnp.int32)
+    counts = jnp.array([3, 2], dtype=jnp.int32)  # full, unclipped
+    vals = jnp.array([5.0, 6.0, 7.0, 8.0, 9.0])
+    out = pack.pack_by_destination(dest, counts, (vals,), capacity=2)[0]
+    np.testing.assert_array_equal(np.asarray(out), [[5.0, 6.0], [7.0, 9.0]])
+
+
+def test_pack_multifield_shares_permutation(rng):
+    n, R, C = 257, 4, 128
+    dest = jnp.asarray(rng.integers(0, R, size=n).astype(np.int32))
+    counts = jnp.asarray(
+        np.bincount(np.asarray(dest), minlength=R).astype(np.int32)
+    )
+    a = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    b = jnp.asarray(np.arange(n, dtype=np.int64))
+    pa, pb = pack.pack_by_destination(dest, counts, (a, b), C)
+    pa, pb, dest_np = np.asarray(pa), np.asarray(pb), np.asarray(dest)
+    for r in range(R):
+        rows = np.flatnonzero(dest_np == r)[: C]
+        np.testing.assert_array_equal(pb[r, : len(rows)], rows)
+        np.testing.assert_array_equal(pa[r, : len(rows)], np.asarray(a)[rows])
+        assert (pb[r, len(rows):] == 0).all()
+
+
+def test_compact_received_order_and_drop():
+    # R=2, C=3: rank layout with ragged valid counts
+    recv = jnp.asarray(
+        np.array(
+            [[[1.0], [2.0], [99.0]], [[3.0], [4.0], [5.0]]], dtype=np.float32
+        )
+    )
+    recv_counts = jnp.array([2, 3], dtype=jnp.int32)
+    out, n, dropped = pack.compact_received((recv,), recv_counts, out_capacity=4)
+    assert int(n) == 4 and int(dropped) == 1
+    np.testing.assert_array_equal(
+        np.asarray(out[0]).ravel(), [1.0, 2.0, 3.0, 4.0]
+    )
+    out2, n2, d2 = pack.compact_received((recv,), recv_counts, out_capacity=8)
+    assert int(n2) == 5 and int(d2) == 0
+    np.testing.assert_array_equal(
+        np.asarray(out2[0]).ravel(), [1.0, 2.0, 3.0, 4.0, 5.0, 0.0, 0.0, 0.0]
+    )
+
+
+def test_pack_jit_static_shapes():
+    f = jax.jit(
+        lambda d, c, v: pack.pack_by_destination(d, c, (v,), capacity=4)
+    )
+    dest = jnp.array([0, 1, 1, 2], dtype=jnp.int32)
+    counts = jnp.array([1, 2, 1], dtype=jnp.int32)
+    out = f(dest, counts, jnp.ones((4, 2)))[0]
+    assert out.shape == (3, 4, 2)
