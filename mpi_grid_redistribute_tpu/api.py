@@ -120,6 +120,19 @@ class GridRedistribute:
 
     def _check_inputs(self, pos, fields, count):
         R = self.nranks
+        # Both backends bin at the same precision: JAX canonicalizes float64
+        # to float32 when x64 is off, and a particle within one float32 ulp
+        # of a cell edge would otherwise land on different ranks per backend,
+        # breaking the advertised bit-level comparability.
+        if self.backend == "numpy":
+            pos = np.asarray(pos)
+            pos = pos.astype(jax.dtypes.canonicalize_dtype(pos.dtype))
+            fields = tuple(
+                np.asarray(f).astype(
+                    jax.dtypes.canonicalize_dtype(np.asarray(f).dtype)
+                )
+                for f in fields
+            )
         if pos.ndim != 2 or pos.shape[1] != self.domain.ndim:
             raise ValueError(
                 f"positions must be [R*n_local, {self.domain.ndim}], "
@@ -138,19 +151,26 @@ class GridRedistribute:
                 )
         if count is None:
             count = np.full((R,), n_local, dtype=np.int32)
-        count_host = np.asarray(count, dtype=np.int32)
-        if count_host.shape != (R,):
-            raise ValueError(f"count must be [{R}], got {count_host.shape}")
-        if (count_host < 0).any() or (count_host > n_local).any():
-            raise ValueError(
-                f"count entries must be in [0, {n_local}], got {count_host}"
+        if isinstance(count, jax.Array):
+            # Device array (e.g. the previous step's result.count): validate
+            # on device — a host check would block async dispatch.
+            if count.shape != (R,):
+                raise ValueError(f"count must be [{R}], got {count.shape}")
+            count = jnp.clip(count.astype(jnp.int32), 0, n_local)
+            if self.backend == "numpy":
+                count = np.asarray(count)
+        else:
+            count_host = np.asarray(count, dtype=np.int32)
+            if count_host.shape != (R,):
+                raise ValueError(f"count must be [{R}], got {count_host.shape}")
+            if (count_host < 0).any() or (count_host > n_local).any():
+                raise ValueError(
+                    f"count entries must be in [0, {n_local}], got {count_host}"
+                )
+            count = (
+                jnp.asarray(count_host) if self.backend == "jax" else count_host
             )
-        count = (
-            jnp.asarray(count_host)
-            if self.backend == "jax"
-            else count_host
-        )
-        return n_local, count
+        return pos, fields, n_local, count
 
     def redistribute(self, positions, *fields, count=None) -> RedistributeResult:
         """Bin, pack, exchange: every particle moves to its owner shard.
@@ -158,16 +178,18 @@ class GridRedistribute:
         Returns a :class:`RedistributeResult` in the same global padded
         layout (leading dim ``R * out_capacity``).
         """
-        n_local, count = self._check_inputs(positions, fields, count)
+        positions, fields, n_local, count = self._check_inputs(
+            positions, fields, count
+        )
         cap, out_cap = self._capacities(n_local)
         if self.backend == "numpy":
             pos_out, counts_out, fields_out, stats = (
                 oracle.redistribute_oracle_padded(
                     self.domain,
                     self.grid,
-                    np.asarray(positions),
-                    np.asarray(count),
-                    [np.asarray(f) for f in fields],
+                    positions,
+                    count,
+                    list(fields),
                     cap,
                     out_cap,
                 )

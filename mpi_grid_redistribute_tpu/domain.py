@@ -43,6 +43,10 @@ class Domain:
       hi: per-axis upper bounds (exclusive; a particle exactly at ``hi`` is
         wrapped when periodic, clamped into the last cell otherwise).
       periodic: per-axis periodic-boundary flags.
+
+    Scalar ``lo``/``hi`` default to a **3D** cube; pass ``ndim=`` explicitly
+    for other dimensionalities (``Domain(0.0, 1.0, ndim=2)``), or give
+    per-axis sequences.
     """
 
     lo: Tuple[float, ...]
@@ -150,7 +154,8 @@ class ProcessGrid:
     def neighbor_rank(self, rank: int, axis: int, step: int,
                       periodic: bool) -> int:
         """Rank of the neighbor ``step`` cells along ``axis``; -1 if off-grid
-        and not periodic (used by the halo exchange)."""
+        and not periodic. (The halo exchange computes neighbors implicitly
+        via ``ppermute`` rings; this is for tests and custom patterns.)"""
         cell = list(self.cell_of_rank(rank))
         c = cell[axis] + step
         g = self.shape[axis]
@@ -165,7 +170,9 @@ class ProcessGrid:
         if self.ndim != domain.ndim:
             raise ValueError(
                 f"grid ndim {self.ndim} != domain ndim {domain.ndim}; pad the "
-                f"grid shape with 1s for undecomposed axes"
+                f"grid shape with 1s for undecomposed axes, or pass "
+                f"Domain(lo, hi, ndim={self.ndim}) — scalar bounds default "
+                f"to a 3D domain"
             )
 
     def cell_widths(self, domain: Domain) -> Tuple[float, ...]:

@@ -1,0 +1,3 @@
+"""Driver pipelines built on the redistribute core: N-body drift loop
+(BASELINE config 4) and the fused redistribute + CIC deposit particle-mesh
+pipeline (config 5)."""

@@ -1,0 +1,126 @@
+"""Periodic N-body drift loop + fused particle-mesh pipeline.
+
+Rebuilds the reference's driver-defined composite flows (SURVEY.md §3.3-3.4,
+BASELINE.json configs[3] and [4] — mount empty):
+
+  config 4:  for step in range(S): pos += vel*dt; wrap; redistribute(pos, vel)
+  config 5:  redistribute(pos, mass) then CIC-deposit onto the rank mesh
+
+TPU-first shape: the whole step (drift + wrap + bin + pack + all_to_all +
+compact [+ deposit]) is ONE jitted SPMD program; multi-step runs use
+``lax.scan`` so S steps compile once with static shapes. ``out_capacity``
+equals the input padding, making the step state a fixed-shape carry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning, deposit as deposit_lib
+from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Static configuration for the drift loop (hashable: jit-safe)."""
+
+    domain: Domain
+    grid: ProcessGrid
+    dt: float
+    capacity: int
+    n_local: int  # padded rows per shard; also the out_capacity
+    deposit_shape: Optional[Tuple[int, ...]] = None  # global CIC mesh cells
+
+
+def make_drift_step(cfg: DriftConfig, mesh: Mesh):
+    """Build the jitted single-step function.
+
+    ``step(pos, vel, count) -> (pos, vel, count, stats[, rho])`` on global
+    padded arrays ([R*n_local, ...] / [R]); ``rho`` is the global density
+    mesh when ``cfg.deposit_shape`` is set.
+    """
+    mesh_lib.validate_mesh_for_grid(mesh, cfg.grid)
+    axes = cfg.grid.axis_names
+    spec = P(axes)
+    redist = exchange.shard_redistribute_fn(
+        cfg.domain, cfg.grid, cfg.capacity, cfg.n_local
+    )
+    dep_fn = None
+    if cfg.deposit_shape is not None:
+        dep_fn, _ = deposit_lib.shard_deposit_fn(
+            cfg.domain, cfg.grid, cfg.deposit_shape
+        )
+
+    def shard_step(pos, vel, count):
+        pos = pos + vel * jnp.asarray(cfg.dt, pos.dtype)
+        pos = binning.wrap_periodic(pos, cfg.domain)
+        pos, count, vel, stats = redist(pos, count, vel)
+        if dep_fn is None:
+            return pos, vel, count, stats
+        rho = dep_fn(pos, jnp.ones(pos.shape[:1], pos.dtype), count)
+        return pos, vel, count, stats, rho
+
+    out_specs = (
+        spec,
+        spec,
+        spec,
+        exchange.RedistributeStats(spec, spec, spec, spec),
+    )
+    if dep_fn is not None:
+        out_specs = out_specs + (P(*axes),)
+    return jax.jit(
+        shard_map(
+            shard_step, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=out_specs,
+        )
+    )
+
+
+def make_drift_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
+    """S steps in one compiled program via ``lax.scan``.
+
+    Returns ``loop(pos, vel, count) -> (pos, vel, count, stats)`` where
+    stats leaves are stacked per step ([S, ...]); with a deposit mesh
+    configured, the *final* step's density is also returned (keeping only
+    the last avoids an S-times-larger live buffer).
+    """
+    step = make_drift_step(
+        dataclasses.replace(cfg, deposit_shape=None), mesh
+    )
+    dep = None
+    if cfg.deposit_shape is not None:
+        dep = build_deposit_step(cfg, mesh)
+
+    def loop(pos, vel, count):
+        def body(carry, _):
+            p, v, c = carry
+            p, v, c, stats = step(p, v, c)
+            return (p, v, c), stats
+
+        (pos_f, vel_f, count_f), stats = lax.scan(
+            body, (pos, vel, count), None, length=n_steps
+        )
+        if dep is None:
+            return pos_f, vel_f, count_f, stats
+        rho = dep(pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), count_f)
+        return pos_f, vel_f, count_f, stats, rho
+
+    return jax.jit(loop)
+
+
+def build_deposit_step(cfg: DriftConfig, mesh: Mesh):
+    """Standalone fused deposit on already-redistributed state (config 5)."""
+    if cfg.deposit_shape is None:
+        raise ValueError("cfg.deposit_shape is required for deposit")
+    return deposit_lib.build_deposit(
+        mesh, cfg.domain, cfg.grid, cfg.deposit_shape
+    )

@@ -1,0 +1,188 @@
+"""Cloud-in-cell (CIC) particle-mesh deposit (SURVEY.md §3.4, config 5).
+
+The reference's fused pipeline deposits redistributed particle mass onto a
+rank-local density mesh with a scatter-add, folding ghost-layer faces across
+subdomain boundaries (SURVEY.md C8/§3.4 — mount empty, spec from
+BASELINE.json configs[4]). TPU-native realization:
+
+  * per-shard CIC: each particle spreads ``mass * w`` to the 2^ndim mesh
+    nodes around it; the scatter-add is ``jax.ops.segment_sum`` on flattened
+    node indices (deterministic on TPU, SURVEY.md §5.2);
+  * the shard's local mesh carries a +1 ghost layer on the upper side of
+    each decomposed axis; after deposit the ghost faces are folded into the
+    downstream neighbor with one ``lax.ppermute`` per axis (sequential
+    folds handle edges/corners exactly);
+  * periodic domains only — the canonical N-body case; CIC node count per
+    axis equals the cell count, nodes wrap.
+
+Shapes are static throughout; the deposit fuses into the same jit as the
+redistribute for the config-5 pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+
+
+def _check_mesh_shape(
+    domain: Domain, grid: ProcessGrid, mesh_shape: Tuple[int, ...]
+):
+    if len(mesh_shape) != domain.ndim:
+        raise ValueError(
+            f"mesh_shape must have {domain.ndim} axes, got {mesh_shape}"
+        )
+    if not all(domain.periodic):
+        raise NotImplementedError(
+            "CIC deposit currently requires a fully periodic domain "
+            "(the reference's N-body use case); non-periodic node meshes "
+            "are ragged across ranks"
+        )
+    for a, (m, g) in enumerate(zip(mesh_shape, grid.shape)):
+        if m % g:
+            raise ValueError(
+                f"axis {a}: mesh cells {m} not divisible by grid extent {g}"
+            )
+
+
+def cic_deposit_local(
+    pos: jax.Array,
+    mass: jax.Array,
+    valid: jax.Array,
+    lo_local: jax.Array,
+    inv_h: jax.Array,
+    local_shape: Tuple[int, ...],
+) -> jax.Array:
+    """CIC-deposit onto this shard's local node mesh (+1 upper ghost/axis).
+
+    Particle coordinates are assumed already wrapped into the global domain
+    and owned by this shard, so ``(pos - lo_local) * inv_h`` lies in
+    ``[0, local_shape)``; the +1 ghost row absorbs the upper-face spill.
+    """
+    ndim = pos.shape[1]
+    ghost_shape = tuple(m + 1 for m in local_shape)
+    rel = (pos - lo_local) * inv_h
+    i0 = jnp.floor(rel).astype(jnp.int32)
+    i0 = jnp.clip(i0, 0, jnp.asarray(local_shape, jnp.int32) - 1)
+    frac = rel - i0.astype(rel.dtype)
+    frac = jnp.clip(frac, 0.0, 1.0)
+
+    strides = []
+    acc = 1
+    for m in reversed(ghost_shape):
+        strides.append(acc)
+        acc *= m
+    strides = jnp.asarray(list(reversed(strides)), jnp.int32)
+    nnodes = math.prod(ghost_shape)
+
+    w_valid = jnp.where(valid, mass, 0.0)
+    total = jnp.zeros((nnodes,), dtype=mass.dtype)
+    for corner in itertools.product((0, 1), repeat=ndim):
+        off = jnp.asarray(corner, jnp.int32)
+        w = jnp.prod(
+            jnp.where(off == 1, frac, 1.0 - frac), axis=1
+        )
+        idx = jnp.sum((i0 + off) * strides, axis=1)
+        total = total + jax.ops.segment_sum(
+            w_valid * w, idx, num_segments=nnodes
+        )
+    return total.reshape(ghost_shape)
+
+
+def fold_ghosts(
+    rho_ghost: jax.Array, grid: ProcessGrid
+) -> jax.Array:
+    """Fold each axis's upper ghost face into the +1 neighbor's lower row.
+
+    One ``ppermute`` per decomposed axis (periodic wrap); axes with grid
+    extent 1 wrap onto self, which is the correct periodic self-fold.
+    Sequential folding propagates edge/corner ghost mass exactly.
+    """
+    for a, name in enumerate(grid.axis_names):
+        g = grid.shape[a]
+        m = rho_ghost.shape[a] - 1
+        ghost = lax.slice_in_dim(rho_ghost, m, m + 1, axis=a)
+        body = lax.slice_in_dim(rho_ghost, 0, m, axis=a)
+        if g == 1:
+            recv = ghost
+        else:
+            recv = lax.ppermute(
+                ghost, name, perm=[(i, (i + 1) % g) for i in range(g)]
+            )
+        first = lax.slice_in_dim(body, 0, 1, axis=a) + recv
+        rest = lax.slice_in_dim(body, 1, m, axis=a)
+        rho_ghost = jnp.concatenate([first, rest], axis=a)
+    return rho_ghost
+
+
+def shard_deposit_fn(
+    domain: Domain, grid: ProcessGrid, mesh_shape: Tuple[int, ...]
+):
+    """Per-shard deposit closure for use under ``shard_map``.
+
+    Signature: ``(pos[N,D], mass[N], count[1]) -> rho_local[local_shape]``.
+    """
+    _check_mesh_shape(domain, grid, mesh_shape)
+    local_shape = tuple(m // g for m, g in zip(mesh_shape, grid.shape))
+    inv_h = jnp.asarray(
+        [m / e for m, e in zip(mesh_shape, domain.extent)], jnp.float32
+    )
+    widths = grid.cell_widths(domain)
+
+    def fn(pos, mass, count):
+        me_cell = [
+            lax.axis_index(name).astype(jnp.int32)
+            for name in grid.axis_names
+        ]
+        lo_local = jnp.stack(
+            [
+                jnp.asarray(domain.lo[a], jnp.float32)
+                + me_cell[a].astype(jnp.float32)
+                * jnp.asarray(widths[a], jnp.float32)
+                for a in range(domain.ndim)
+            ]
+        )
+        valid = jnp.arange(pos.shape[0], dtype=jnp.int32) < count[0]
+        rho = cic_deposit_local(pos, mass, valid, lo_local, inv_h, local_shape)
+        return fold_ghosts(rho, grid)
+
+    return fn, local_shape
+
+
+def build_deposit(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    mesh_shape: Tuple[int, ...],
+):
+    """jit-compiled global CIC deposit over ``mesh``.
+
+    Global layout: ``pos`` [R*n_local, D] / ``mass`` [R*n_local] /
+    ``count`` [R], all sharded like the redistribute outputs; returns the
+    global density mesh ``[mesh_shape]`` sharded over the grid axes.
+    """
+    fn, _ = shard_deposit_fn(domain, grid, mesh_shape)
+    axes = grid.axis_names
+    spec = P(axes)
+    out_spec = P(*axes)  # rho axis a sharded over mesh axis a
+
+    def trimmed(pos, mass, count):
+        return fn(pos, mass, count)
+
+    sharded = shard_map(
+        trimmed,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=out_spec,
+    )
+    return jax.jit(sharded)

@@ -51,6 +51,10 @@ def redistribute_oracle(
     R = grid.nranks
     if len(pos_shards) != R:
         raise ValueError(f"expected {R} shards, got {len(pos_shards)}")
+    if field_shards and len(field_shards) != R:
+        raise ValueError(
+            f"expected {R} field shards, got {len(field_shards)}"
+        )
     for r, fields in enumerate(field_shards):
         for f in fields:
             if f.shape[0] != pos_shards[r].shape[0]:

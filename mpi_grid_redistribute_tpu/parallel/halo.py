@@ -1,0 +1,209 @@
+"""Halo / ghost-particle exchange (SURVEY.md C8, §3.4).
+
+Stencil ops (CIC deposit with force interpolation, short-range forces) need
+copies of neighbor shards' particles within ``halo_width`` of the subdomain
+faces. The reference family does this with extra MPI sends (SURVEY.md C8,
+[RECALL] — mount empty); the TPU-native design is the classic 2-passes-per-
+axis exchange on the device mesh:
+
+  * per axis, take a snapshot of (own + already-received) particles, select
+    the slabs within ``halo_width`` of the hi/lo faces, and ``lax.ppermute``
+    each padded slab one step along that mesh axis (+1, then -1);
+  * received ghosts participate in *later* axes' passes, so edge and corner
+    ghosts propagate in at most ``ndim`` hops with only ``2 * ndim``
+    collectives (not 3^ndim - 1 neighbor sends);
+  * crossing a periodic wrap shifts the ghost coordinate by ±extent so
+    ghost positions are continuous in the receiver's frame;
+  * everything is capacity-padded ([pass_capacity] per hop,
+    [ghost_capacity] total) with overflow counted and surfaced.
+
+``halo_width`` must not exceed the per-axis subdomain width: one hop per
+axis is exactly the single-neighbor-shell guarantee.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops.pack import _stable_order, _take_rows, _mask_rows
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+
+class HaloResult(NamedTuple):
+    """Global ghost buffers: positions [R*ghost_capacity, D] (shifted into
+    the receiver's frame across periodic wraps), per-shard ghost counts [R],
+    carried fields, and the per-shard overflow counter [R]."""
+
+    ghost_positions: jax.Array
+    ghost_count: jax.Array
+    ghost_fields: Tuple
+    overflow: jax.Array
+
+
+def _as_per_axis(width, ndim: int) -> Tuple[float, ...]:
+    if isinstance(width, (int, float)):
+        return (float(width),) * ndim
+    t = tuple(float(w) for w in width)
+    if len(t) != ndim:
+        raise ValueError(f"halo_width must have {ndim} entries, got {len(t)}")
+    return t
+
+
+def shard_halo_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+):
+    """Per-shard halo exchange closure (runs under ``shard_map``).
+
+    Signature: ``(pos[N,D], count[1], *fields) ->
+    (ghost_pos[G,D], ghost_count[1], *ghost_fields, overflow[1])``.
+    """
+    ndim = domain.ndim
+    widths = _as_per_axis(halo_width, ndim)
+    cell_w = grid.cell_widths(domain)
+    for a in range(ndim):
+        if widths[a] < 0:
+            raise ValueError(f"halo_width[{a}] must be >= 0")
+        if widths[a] > cell_w[a]:
+            raise ValueError(
+                f"halo_width[{a}]={widths[a]} exceeds subdomain width "
+                f"{cell_w[a]}; multi-hop halos are not supported"
+            )
+    H, G = pass_capacity, ghost_capacity
+
+    def fn(pos, count, *fields):
+        n = pos.shape[0]
+        valid = jnp.arange(n, dtype=jnp.int32) < count[0]
+        arrays = (pos,) + tuple(fields)
+        ghost = jax.tree.map(
+            lambda a: jnp.zeros((G,) + a.shape[1:], a.dtype), arrays
+        )
+        gcount = jnp.zeros((), jnp.int32)
+        overflow = jnp.zeros((), jnp.int32)
+
+        for a, name in enumerate(grid.axis_names):
+            g = grid.shape[a]
+            w = jnp.asarray(widths[a], pos.dtype)
+            extent_a = jnp.asarray(domain.extent[a], pos.dtype)
+            coord_idx = lax.axis_index(name).astype(jnp.int32)
+            lo_a = (
+                jnp.asarray(domain.lo[a], pos.dtype)
+                + coord_idx.astype(pos.dtype) * jnp.asarray(cell_w[a], pos.dtype)
+            )
+            hi_a = lo_a + jnp.asarray(cell_w[a], pos.dtype)
+
+            # Snapshot BEFORE this axis's passes: both directions select from
+            # it, so a ghost just received from -x is never bounced back +x.
+            cand = jax.tree.map(
+                lambda own, gh: jnp.concatenate([own, gh], axis=0),
+                arrays,
+                ghost,
+            )
+            cand_valid = jnp.concatenate(
+                [valid, jnp.arange(G, dtype=jnp.int32) < gcount]
+            )
+            coord = cand[0][:, a]
+
+            incoming = []
+            for dirn in (1, -1):
+                if dirn == 1:
+                    mask = cand_valid & (coord >= hi_a - w)
+                    at_edge = coord_idx == g - 1
+                else:
+                    mask = cand_valid & (coord < lo_a + w)
+                    at_edge = coord_idx == 0
+                if not domain.periodic[a]:
+                    mask = mask & jnp.logical_not(at_edge)
+                cnt = jnp.sum(mask.astype(jnp.int32))
+                overflow = overflow + jnp.maximum(cnt - H, 0)
+                send_cnt = jnp.minimum(cnt, H)
+                order = _stable_order(~mask)
+                take = _take_rows(order, H)
+                slot_valid = jnp.arange(H, dtype=jnp.int32) < send_cnt
+                send = jax.tree.map(
+                    lambda arr: _mask_rows(
+                        jnp.take(arr, take, axis=0), slot_valid
+                    ),
+                    cand,
+                )
+                # Periodic wrap: shift the ghost coordinate into the
+                # receiver's frame (+1 across hi wrap -> subtract extent).
+                shift = jnp.where(
+                    at_edge & domain.periodic[a],
+                    -jnp.asarray(dirn, pos.dtype) * extent_a,
+                    jnp.asarray(0, pos.dtype),
+                )
+                send_pos = send[0].at[:, a].add(
+                    jnp.where(slot_valid, shift, 0)
+                )
+                send = (send_pos,) + tuple(send[1:])
+                perm = [(i, (i + dirn) % g) for i in range(g)]
+                recv = jax.tree.map(
+                    lambda arr: lax.ppermute(arr, name, perm), send
+                )
+                recv_cnt = lax.ppermute(send_cnt, name, perm)
+                incoming.append((recv, recv_cnt))
+
+            for recv, recv_cnt in incoming:
+                app_valid = jnp.arange(H, dtype=jnp.int32) < recv_cnt
+                overflow = overflow + jnp.maximum(gcount + recv_cnt - G, 0)
+                idx = jnp.where(
+                    app_valid, gcount + jnp.arange(H, dtype=jnp.int32), G
+                )
+                ghost = jax.tree.map(
+                    lambda gh, rc: gh.at[idx].set(rc, mode="drop"),
+                    ghost,
+                    recv,
+                )
+                gcount = jnp.minimum(gcount + recv_cnt, G)
+
+        return (
+            (ghost[0], gcount[None])
+            + tuple(ghost[1:])
+            + (overflow[None],)
+        )
+
+    return fn
+
+
+def build_halo_exchange(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+    n_fields: int = 0,
+):
+    """jit-compiled global halo exchange over ``mesh``.
+
+    Global layout matches the redistribute: ``pos`` [R*n_local, D] /
+    ``count`` [R] sharded over the grid axes; returns a :class:`HaloResult`.
+    """
+    mesh_lib.validate_mesh_for_grid(mesh, grid)
+    spec = P(grid.axis_names)
+    fn = shard_halo_fn(domain, grid, halo_width, pass_capacity, ghost_capacity)
+    sharded = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec) + (spec,) * n_fields,
+        out_specs=(spec, spec) + (spec,) * n_fields + (spec,),
+    )
+    jitted = jax.jit(sharded)
+
+    def wrapped(pos, count, *fields):
+        out = jitted(pos, count, *fields)
+        return HaloResult(out[0], out[1], tuple(out[2:-1]), out[-1])
+
+    return wrapped

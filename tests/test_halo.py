@@ -1,0 +1,148 @@
+import itertools
+
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+from mpi_grid_redistribute_tpu import GridRedistribute
+
+
+def brute_force_ghosts(domain, grid, pos_shards, w):
+    """All (image-shifted) particles inside each rank's expanded shell."""
+    R = grid.nranks
+    ndim = domain.ndim
+    ext = np.asarray(domain.extent)
+    shifts = []
+    for vec in itertools.product(*[
+        (-1, 0, 1) if domain.periodic[a] else (0,) for a in range(ndim)
+    ]):
+        shifts.append(np.asarray(vec) * ext)
+    out = []
+    for d in range(R):
+        lo, hi = grid.subdomain_of_rank(d, domain)
+        lo, hi = np.asarray(lo), np.asarray(hi)
+        ghosts = []
+        for s in range(R):
+            for p in pos_shards[s]:
+                for v in shifts:
+                    q = p + v
+                    if (q >= lo - w).all() and (q < hi + w).all():
+                        inside = (q >= lo).all() and (q < hi).all()
+                        if inside and s == d and not v.any():
+                            continue  # own particle, not a ghost
+                        if inside:
+                            continue  # owned by d; only shell copies count
+                        ghosts.append(q)
+        out.append(
+            np.asarray(ghosts, dtype=np.float32)
+            if ghosts
+            else np.zeros((0, ndim), np.float32)
+        )
+    return out
+
+
+def _sorted_rows(a):
+    a = np.asarray(a)
+    return a[np.lexsort(a.T[::-1])]
+
+
+@pytest.mark.parametrize(
+    "grid_shape,periodic",
+    [((2, 2, 2), True), ((2, 2, 2), False), ((4, 2, 1), True)],
+)
+def test_halo_matches_brute_force(rng, grid_shape, periodic):
+    domain = Domain(0.0, 1.0, periodic=periodic)
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    n_local = 64
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    # move particles onto their owners first
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=3 * n_local)
+    res = rd.redistribute(pos)
+    count = np.asarray(res.count)
+    oc = res.positions.shape[0] // R
+    w = 0.08
+    mesh = mesh_lib.make_mesh(grid)
+    hx = halo_lib.build_halo_exchange(
+        mesh, domain, grid, w, pass_capacity=256, ghost_capacity=1024
+    )
+    hres = hx(res.positions, res.count)
+    assert int(np.asarray(hres.overflow).sum()) == 0
+    gcount = np.asarray(hres.ghost_count)
+    gpos = np.asarray(hres.ghost_positions)
+
+    shards = [
+        np.asarray(res.positions)[r * oc : r * oc + count[r]] for r in range(R)
+    ]
+    expected = brute_force_ghosts(domain, grid, shards, w)
+    for r in range(R):
+        got = gpos[r * 1024 : r * 1024 + gcount[r]]
+        exp = expected[r]
+        assert gcount[r] == len(exp), f"rank {r}: {gcount[r]} vs {len(exp)}"
+        np.testing.assert_allclose(
+            _sorted_rows(got), _sorted_rows(exp), atol=1e-5
+        )
+
+
+def test_halo_fields_ride_along(rng):
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 32
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=2 * n_local)
+    res = rd.redistribute(pos, np.arange(R * n_local, dtype=np.int32))
+    mesh = mesh_lib.make_mesh(grid)
+    hx = halo_lib.build_halo_exchange(
+        mesh, domain, grid, 0.1, pass_capacity=128, ghost_capacity=512,
+        n_fields=1,
+    )
+    hres = hx(res.positions, res.count, res.fields[0])
+    gcount = np.asarray(hres.ghost_count)
+    ids = np.asarray(hres.ghost_fields[0])
+    gpos = np.asarray(hres.ghost_positions)
+    # every ghost id refers to a real particle whose (unshifted) position
+    # matches the ghost position modulo the domain extent
+    oc = res.positions.shape[0] // R
+    id2pos = {}
+    cnt = np.asarray(res.count)
+    for r in range(R):
+        for i in range(cnt[r]):
+            id2pos[int(np.asarray(res.fields[0])[r * oc + i])] = np.asarray(
+                res.positions
+            )[r * oc + i]
+    for r in range(R):
+        for k in range(gcount[r]):
+            gid = int(ids[r * 512 + k])
+            q = gpos[r * 512 + k]
+            p = id2pos[gid]
+            np.testing.assert_allclose(q % 1.0, p % 1.0, atol=1e-5)
+
+
+def test_halo_width_validation():
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    with pytest.raises(ValueError):
+        halo_lib.shard_halo_fn(domain, grid, 0.6, 8, 8)  # > cell width 0.5
+    with pytest.raises(ValueError):
+        halo_lib.shard_halo_fn(domain, grid, -0.1, 8, 8)
+
+
+def test_halo_overflow_counted(rng):
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 64
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=2 * n_local)
+    res = rd.redistribute(pos)
+    mesh = mesh_lib.make_mesh(grid)
+    hx = halo_lib.build_halo_exchange(
+        mesh, domain, grid, 0.25, pass_capacity=4, ghost_capacity=8
+    )
+    hres = hx(res.positions, res.count)
+    assert int(np.asarray(hres.overflow).sum()) > 0
+    assert (np.asarray(hres.ghost_count) <= 8).all()
