@@ -1,0 +1,154 @@
+"""Headline benchmark: particles redistributed per second per chip.
+
+Prints ONE JSON line:
+  {"metric": "particles_per_sec_per_chip", "value": N, "unit": "particles/s",
+   "vs_baseline": N}
+
+North star (BASELINE.json / BASELINE.md): >=10x particles/sec vs 8-rank CPU
+MPI on the redistribute pipeline. mpi4py is not installed here (SURVEY.md §4),
+so the baseline denominator is the pure-NumPy 8-rank oracle — the same
+digitize -> histogram -> argsort pack -> Alltoallv-semantics exchange the MPI
+path runs, minus the wire (favorable to the baseline: zero comm cost).
+``vs_baseline`` is therefore (our aggregate particles/sec) / (8-rank CPU
+aggregate particles/sec); >=10 means the north star is met.
+
+Shape of the timed run: the fused periodic drift step (drift + wrap + bin +
+pack + all_to_all + compact — SURVEY.md §3.3, the steady-state workload) on
+a 2x2x2 mesh when >=8 devices are visible, else on the single available chip.
+
+Env overrides: BENCH_N_LOCAL (particles per chip), BENCH_STEPS (timed steps),
+BENCH_BASELINE_N (CPU-oracle particle count).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _stderr(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def time_device_pipeline(devs, n_local_per_chip: int, n_steps: int):
+    import jax
+
+    from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+    from mpi_grid_redistribute_tpu.models import nbody
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    if len(devs) >= 8:
+        shape = (2, 2, 2)
+    else:
+        shape = (1, 1, 1)
+    grid = ProcessGrid(shape)
+    R = grid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    mesh = mesh_lib.make_mesh(grid, devices=devs[:R])
+    cfg = nbody.DriftConfig(
+        domain=domain,
+        grid=grid,
+        dt=0.01,
+        capacity=max(1, n_local_per_chip // max(1, R)),
+        n_local=n_local_per_chip,
+    )
+    step = nbody.make_drift_step(cfg, mesh)
+
+    rng = np.random.default_rng(0)
+    n = R * n_local_per_chip
+    pos = rng.random((n, 3), dtype=np.float32)
+    vel = (0.2 * (rng.random((n, 3), dtype=np.float32) - 0.5)).astype(
+        np.float32
+    )
+    count = np.full((R,), n_local_per_chip, dtype=np.int32)
+
+    t0 = time.perf_counter()
+    out = step(pos, vel, count)
+    jax.block_until_ready(out)
+    _stderr(f"compile+first step: {time.perf_counter() - t0:.1f}s")
+    pos_d, vel_d, count_d = out[0], out[1], out[2]
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        pos_d, vel_d, count_d, _stats = step(pos_d, vel_d, count_d)
+    jax.block_until_ready((pos_d, vel_d, count_d))
+    dt = (time.perf_counter() - t0) / n_steps
+    total_particles = R * n_local_per_chip
+    return total_particles / dt, R, dt
+
+
+def time_cpu_oracle(n_total: int, n_steps: int):
+    """8-rank pure-NumPy oracle: the CPU-MPI stand-in (no wire cost)."""
+    from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+    from mpi_grid_redistribute_tpu import oracle
+
+    grid = ProcessGrid((2, 2, 2))
+    R = grid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = n_total // R
+    cap = max(1, n_local // R)
+    rng = np.random.default_rng(0)
+    pos = rng.random((R * n_local, 3), dtype=np.float32)
+    vel = 0.2 * (rng.random((R * n_local, 3), dtype=np.float32) - 0.5)
+    count = np.full((R,), n_local, dtype=np.int32)
+    dt_drift = np.float32(0.01)
+
+    def one_step(pos, vel, count):
+        pos = (pos + vel * dt_drift) % np.float32(1.0)
+        pos, count, (vel,), _stats = oracle.redistribute_oracle_padded(
+            domain, grid, pos, count, [vel], cap, n_local
+        )
+        return pos, vel, count
+
+    pos, vel, count = one_step(pos, vel, count)  # warm caches
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        pos, vel, count = one_step(pos, vel, count)
+    dt = (time.perf_counter() - t0) / n_steps
+    return (R * n_local) / dt
+
+
+def main() -> None:
+    import jax
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    on_tpu = platform not in ("cpu",)
+    n_local = int(
+        os.environ.get("BENCH_N_LOCAL", 2**22 if on_tpu else 2**16)
+    )
+    n_steps = int(os.environ.get("BENCH_STEPS", 10))
+    baseline_n = int(os.environ.get("BENCH_BASELINE_N", 2**21))
+
+    _stderr(
+        f"devices: {len(devs)} x {platform}; n_local/chip={n_local}, "
+        f"steps={n_steps}"
+    )
+    pps, n_chips, step_dt = time_device_pipeline(devs, n_local, n_steps)
+    pps_per_chip = pps / n_chips
+    _stderr(
+        f"device pipeline: {pps:.3e} particles/s aggregate on {n_chips} "
+        f"chip(s) ({step_dt*1e3:.2f} ms/step)"
+    )
+
+    cpu_pps = time_cpu_oracle(baseline_n, max(2, n_steps // 3))
+    _stderr(f"8-rank CPU oracle baseline: {cpu_pps:.3e} particles/s")
+
+    print(
+        json.dumps(
+            {
+                "metric": "particles_per_sec_per_chip",
+                "value": round(pps_per_chip, 2),
+                "unit": "particles/s",
+                "vs_baseline": round(pps / cpu_pps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -126,11 +126,12 @@ class GridRedistribute:
         # breaking the advertised bit-level comparability.
         if self.backend == "numpy":
             pos = np.asarray(pos)
-            pos = pos.astype(jax.dtypes.canonicalize_dtype(pos.dtype))
+            pos = pos.astype(
+                jax.dtypes.canonicalize_dtype(pos.dtype), copy=False
+            )
+            fields = tuple(np.asarray(f) for f in fields)
             fields = tuple(
-                np.asarray(f).astype(
-                    jax.dtypes.canonicalize_dtype(np.asarray(f).dtype)
-                )
+                f.astype(jax.dtypes.canonicalize_dtype(f.dtype), copy=False)
                 for f in fields
             )
         if pos.ndim != 2 or pos.shape[1] != self.domain.ndim:
@@ -151,14 +152,12 @@ class GridRedistribute:
                 )
         if count is None:
             count = np.full((R,), n_local, dtype=np.int32)
-        if isinstance(count, jax.Array):
-            # Device array (e.g. the previous step's result.count): validate
-            # on device — a host check would block async dispatch.
+        if isinstance(count, jax.Array) and self.backend == "jax":
+            # Device array (e.g. the previous step's result.count): clip
+            # on device — a host-side range check would block async dispatch.
             if count.shape != (R,):
                 raise ValueError(f"count must be [{R}], got {count.shape}")
             count = jnp.clip(count.astype(jnp.int32), 0, n_local)
-            if self.backend == "numpy":
-                count = np.asarray(count)
         else:
             count_host = np.asarray(count, dtype=np.int32)
             if count_host.shape != (R,):

@@ -15,7 +15,7 @@ equals the input padding, making the step state a fixed-shape carry.
 from __future__ import annotations
 
 import dataclasses
-import functools
+
 from typing import Optional, Tuple
 
 import jax

@@ -21,10 +21,9 @@ redistribute for the config-5 pipeline.
 
 from __future__ import annotations
 
-import functools
 import itertools
 import math
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -176,11 +175,8 @@ def build_deposit(
     spec = P(axes)
     out_spec = P(*axes)  # rho axis a sharded over mesh axis a
 
-    def trimmed(pos, mass, count):
-        return fn(pos, mass, count)
-
     sharded = shard_map(
-        trimmed,
+        fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=out_spec,

@@ -23,7 +23,6 @@ axis is exactly the single-neighbor-shell guarantee.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Tuple
 
 import jax
