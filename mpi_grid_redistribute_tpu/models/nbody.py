@@ -26,7 +26,7 @@ from jax import shard_map
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning, deposit as deposit_lib
-from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
+from mpi_grid_redistribute_tpu.parallel import exchange, migrate, mesh as mesh_lib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +115,127 @@ def make_drift_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
         return pos_f, vel_f, count_f, stats, rho
 
     return jax.jit(loop)
+
+
+def make_migrate_step(cfg: DriftConfig, mesh: Mesh):
+    """Fast drift step on resident slots (see :mod:`..parallel.migrate`).
+
+    State is ``(pos[R*n_local, D], vel[R*n_local, D], alive[R*n_local])``;
+    only boundary-crossing migrants ride the all-to-all, so per-step cost
+    scales with migrant count, not total particles (full-array row gathers
+    dominate the canonical :func:`make_drift_step` on TPU).
+    ``cfg.capacity`` here bounds *migrants* per (source, dest) pair.
+
+    Returns ``step(pos, vel, alive) -> (pos, vel, alive, stats[, rho])``.
+    """
+    mesh_lib.validate_mesh_for_grid(mesh, cfg.grid)
+    axes = cfg.grid.axis_names
+    spec = P(axes)
+    mig = migrate.shard_migrate_fn(cfg.domain, cfg.grid, cfg.capacity)
+    dep_fn = None
+    if cfg.deposit_shape is not None:
+        dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
+            cfg.domain, cfg.grid, cfg.deposit_shape
+        )
+
+    def shard_step(pos, vel, alive):
+        pos = pos + vel * jnp.asarray(cfg.dt, pos.dtype)
+        pos = binning.wrap_periodic(pos, cfg.domain)
+        pos, alive, vel, stats = mig(pos, alive, vel)
+        if dep_fn is None:
+            return pos, vel, alive, stats
+        rho = dep_fn(pos, jnp.ones(pos.shape[:1], pos.dtype), alive)
+        return pos, vel, alive, stats, rho
+
+    stats_spec = migrate.MigrateStats(*([spec] * len(migrate.MigrateStats._fields)))
+    out_specs = (spec, spec, spec, stats_spec)
+    if dep_fn is not None:
+        out_specs = out_specs + (P(*axes),)
+    return jax.jit(
+        shard_map(
+            shard_step, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=out_specs,
+        )
+    )
+
+
+def make_migrate_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
+    """S fast-migration steps in one compiled program via ``lax.scan``.
+
+    ``loop(pos, vel, alive) -> (pos, vel, alive, stats)`` with stats leaves
+    stacked per step ([S, R]); with ``cfg.deposit_shape`` set, the final
+    step's global density mesh is appended.
+
+    The scan carry is the *fused* ``[n, 2D]`` payload matrix (position +
+    velocity columns), fused once on entry and split once on exit, so each
+    step moves migrants with a single gather/all_to_all/scatter
+    (:mod:`..parallel.migrate`).
+    """
+    mesh_lib.validate_mesh_for_grid(mesh, cfg.grid)
+    axes = cfg.grid.axis_names
+    spec = P(axes)
+    D = cfg.domain.ndim
+    mig = migrate.shard_migrate_fused_fn(cfg.domain, cfg.grid, cfg.capacity)
+    dep_fn = None
+    if cfg.deposit_shape is not None:
+        dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
+            cfg.domain, cfg.grid, cfg.deposit_shape
+        )
+
+    def shard_loop(pos, vel, alive):
+        fused, specs = migrate.fuse_fields((pos, vel), alive)
+        state = migrate.init_state(fused)
+        # scan requires carry leaves already marked device-varying (some
+        # init_state outputs are iota-derived and start unvaried)
+        def _vary(x):
+            missing = tuple(a for a in axes if a not in jax.typeof(x).vma)
+            return lax.pcast(x, missing, to="varying") if missing else x
+
+        state = jax.tree.map(_vary, state)
+
+        def body(state, _):
+            f = state.fused
+            p = f[:, :D] + f[:, D : 2 * D] * jnp.asarray(cfg.dt, f.dtype)
+            p = binning.wrap_periodic(p, cfg.domain)
+            f = jnp.concatenate([p, f[:, D:]], axis=1)
+            state, stats = mig(state._replace(fused=f))
+            return state, stats
+
+        state, stats = lax.scan(body, state, None, length=n_steps)
+        (pos_f, vel_f), alive_f = migrate.unfuse_fields(state.fused, specs)
+        if dep_fn is None:
+            return pos_f, vel_f, alive_f, stats
+        rho = dep_fn(pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), alive_f)
+        return pos_f, vel_f, alive_f, stats, rho
+
+    # stats leaves are [S, 1] per shard (scan-stacked): shard axis 1.
+    stats_spec = migrate.MigrateStats(
+        *([P(None, axes)] * len(migrate.MigrateStats._fields))
+    )
+    out_specs = (spec, spec, spec, stats_spec)
+    if dep_fn is not None:
+        out_specs = out_specs + (P(*axes),)
+    return jax.jit(
+        shard_map(
+            shard_loop, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=out_specs,
+        )
+    )
+
+
+def build_deposit_masked(cfg: DriftConfig, mesh: Mesh):
+    """Mask-input fused deposit for migration-path state."""
+    if cfg.deposit_shape is None:
+        raise ValueError("cfg.deposit_shape is required for deposit")
+    fn, _ = deposit_lib.shard_deposit_fn_masked(
+        cfg.domain, cfg.grid, cfg.deposit_shape
+    )
+    axes = cfg.grid.axis_names
+    spec = P(axes)
+    sharded = shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(*axes)
+    )
+    return jax.jit(sharded)
 
 
 def build_deposit_step(cfg: DriftConfig, mesh: Mesh):

@@ -71,6 +71,10 @@ def cic_deposit_local(
     ndim = pos.shape[1]
     ghost_shape = tuple(m + 1 for m in local_shape)
     rel = (pos - lo_local) * inv_h
+    # Invalid rows may hold arbitrary bytes (migration holes): zero their
+    # coordinates too, or a NaN position turns the masked weight into
+    # 0 * NaN = NaN and poisons the whole mesh.
+    rel = jnp.where(valid[:, None], rel, 0.0)
     i0 = jnp.floor(rel).astype(jnp.int32)
     i0 = jnp.clip(i0, 0, jnp.asarray(local_shape, jnp.int32) - 1)
     frac = rel - i0.astype(rel.dtype)
@@ -124,12 +128,14 @@ def fold_ghosts(
     return rho_ghost
 
 
-def shard_deposit_fn(
+def shard_deposit_fn_masked(
     domain: Domain, grid: ProcessGrid, mesh_shape: Tuple[int, ...]
 ):
-    """Per-shard deposit closure for use under ``shard_map``.
+    """Per-shard deposit closure taking an explicit validity mask.
 
-    Signature: ``(pos[N,D], mass[N], count[1]) -> rho_local[local_shape]``.
+    Signature: ``(pos[N,D], mass[N], valid[N] bool) ->
+    rho_local[local_shape]``. Used by the resident-slot migration path
+    (:mod:`..parallel.migrate`), whose live rows are a mask, not a prefix.
     """
     _check_mesh_shape(domain, grid, mesh_shape)
     local_shape = tuple(m // g for m, g in zip(mesh_shape, grid.shape))
@@ -138,7 +144,7 @@ def shard_deposit_fn(
     )
     widths = grid.cell_widths(domain)
 
-    def fn(pos, mass, count):
+    def fn(pos, mass, valid):
         me_cell = [
             lax.axis_index(name).astype(jnp.int32)
             for name in grid.axis_names
@@ -151,9 +157,24 @@ def shard_deposit_fn(
                 for a in range(domain.ndim)
             ]
         )
-        valid = jnp.arange(pos.shape[0], dtype=jnp.int32) < count[0]
         rho = cic_deposit_local(pos, mass, valid, lo_local, inv_h, local_shape)
         return fold_ghosts(rho, grid)
+
+    return fn, local_shape
+
+
+def shard_deposit_fn(
+    domain: Domain, grid: ProcessGrid, mesh_shape: Tuple[int, ...]
+):
+    """Per-shard deposit closure for use under ``shard_map``.
+
+    Signature: ``(pos[N,D], mass[N], count[1]) -> rho_local[local_shape]``.
+    """
+    masked, local_shape = shard_deposit_fn_masked(domain, grid, mesh_shape)
+
+    def fn(pos, mass, count):
+        valid = jnp.arange(pos.shape[0], dtype=jnp.int32) < count[0]
+        return masked(pos, mass, valid)
 
     return fn, local_shape
 

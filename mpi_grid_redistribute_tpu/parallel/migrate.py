@@ -1,0 +1,309 @@
+"""Resident-state migration: the fast drift-loop exchange (SURVEY.md §3.3).
+
+The general :mod:`exchange` path re-packs every particle into canonical MPI
+``Alltoallv`` receive order each step — full-array gathers plus a pool-wide
+stable sort. Profiling on the real chip shows the true TPU cost model:
+
+  * random-access scatter costs ~85 ns *per row* regardless of row width
+    (a [4M,6] scatter of 256k rows is ~22 ms) — scatters must be few and
+    sized to the data actually moved;
+  * ``segment_sum`` histograms lower to scatter-add (~37 ms at 4M) — counts
+    must come from ``searchsorted`` on already-sorted keys instead;
+  * a full stable sort of 4M int32 keys is ~6 ms; elementwise binning ~3 ms.
+
+Design (one compiled step, all static shapes):
+
+  1. bin -> ``leaving`` mask (alive rows whose owner changed);
+  2. ONE stable key sort groups leaving rows by destination; per-destination
+     counts fall out of ``searchsorted`` on the sorted keys (no scatter-add);
+  3. migrants beyond the per-(source,dest) ``capacity`` simply STAY resident
+     and retry next step (surfaced as ``backlog`` — particles are never
+     dropped on the send side);
+  4. one fused ``[R, C, K]`` ``lax.all_to_all`` moves position + payload +
+     alive column as a single float32 matrix (32-bit fields bitcast);
+  5. arrivals land exactly in the slots vacated by departures, then in slots
+     popped from a carried free-slot *stack* (contiguous dynamic-slice
+     push/pop — never a scatter); one single scatter per step writes
+     payload, alive flag, and vacancy markers together;
+  6. arrivals beyond the shard's free slots are counted in ``dropped_recv``
+     (receiver overflow is the only loss channel, and it is surfaced).
+
+Slot order is *not* the MPI canonical order — arrivals fill arbitrary holes.
+Correctness is therefore set-equality per shard against the oracle (tested),
+not bit-equality; use :mod:`exchange` when canonical order matters.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning
+
+
+class MigrateStats(NamedTuple):
+    """Per-step migration observability (SURVEY.md §5.5). Global shapes [R]
+    (one entry per shard). ``backlog`` counts migrants delayed by per-pair
+    send capacity (they stay resident and retry); ``dropped_recv`` counts
+    arrivals lost to receiver free-slot exhaustion — surfaced, never
+    silent."""
+
+    sent: jax.Array
+    received: jax.Array
+    population: jax.Array
+    backlog: jax.Array
+    dropped_recv: jax.Array
+
+
+class MigrateState(NamedTuple):
+    """Scan-carry state for the fused migration loop.
+
+    ``fused`` is ``[n, K]`` float32: position columns, payload columns, and
+    an alive column last. ``free_stack``/``n_free`` are the hole-slot stack
+    (indices of dead rows; only the first ``n_free`` entries are live)."""
+
+    fused: jax.Array
+    free_stack: jax.Array
+    n_free: jax.Array
+
+
+def fuse_fields(arrays: Sequence[jax.Array], alive: jax.Array):
+    """Pack [n, ...] arrays + alive mask into one [n, K] float32 matrix.
+
+    32-bit dtypes are bitcast; the fused matrix only ever moves bytes
+    (gather/scatter/all_to_all), so bit patterns survive exactly. The alive
+    mask becomes the last column (1.0/0.0).
+
+    Returns ``(fused, specs)``; ``specs`` drives :func:`unfuse_fields`.
+    """
+    n = arrays[0].shape[0]
+    parts, specs = [], []
+    for a in arrays:
+        if a.dtype.itemsize != 4:
+            raise TypeError(
+                f"fused migration payload requires 32-bit dtypes, got "
+                f"{a.dtype}; cast or split the field"
+            )
+        flat = a.reshape(n, -1)
+        if flat.dtype != jnp.float32:
+            flat = lax.bitcast_convert_type(flat, jnp.float32)
+        parts.append(flat)
+        specs.append((a.shape[1:], a.dtype))
+    parts.append(alive.astype(jnp.float32)[:, None])
+    return jnp.concatenate(parts, axis=1), tuple(specs)
+
+
+def unfuse_fields(fused: jax.Array, specs):
+    """Inverse of :func:`fuse_fields`: ``(arrays..., alive)``."""
+    out = []
+    col = 0
+    n = fused.shape[0]
+    for shape, dtype in specs:
+        k = 1
+        for s in shape:
+            k *= s
+        flat = fused[:, col : col + k]
+        if dtype != jnp.float32:
+            flat = lax.bitcast_convert_type(flat, dtype)
+        out.append(flat.reshape((n,) + tuple(shape)))
+        col += k
+    alive = fused[:, -1] > 0.5
+    return tuple(out), alive
+
+
+def init_state(fused: jax.Array) -> MigrateState:
+    """Build the free-slot stack from the fused matrix's alive column.
+
+    One-time cost (a full argsort) at loop entry; the stack is maintained
+    incrementally afterwards.
+    """
+    n = fused.shape[0]
+    alive = fused[:, -1] > 0.5
+    # dead slots first, ascending slot order
+    free_stack = jnp.argsort(
+        jnp.where(alive, jnp.int32(1), jnp.int32(0)), stable=True
+    ).astype(jnp.int32)
+    n_free = jnp.sum((~alive).astype(jnp.int32))
+    return MigrateState(fused, free_stack, n_free)
+
+
+def _segment_of(k: jax.Array, cum: jax.Array) -> jax.Array:
+    """For flat output position(s) ``k``, the segment index under exclusive
+    cumulative counts ``cum`` ([R+1], cum[0]=0): the d with
+    cum[d] <= k < cum[d+1]. Pure searchsorted — no scatter."""
+    return (
+        jnp.searchsorted(cum, k, side="right").astype(jnp.int32) - 1
+    )
+
+
+def shard_migrate_fused_fn(
+    domain: Domain, grid: ProcessGrid, capacity: int, ndim: int = None
+):
+    """Per-shard migration on fused state (runs under ``shard_map``).
+
+    Signature of the returned fn:
+      ``MigrateState -> (MigrateState, MigrateStats)``
+    where ``state.fused`` is ``[n, K]`` with columns ``0:ndim`` the position
+    (default ``domain.ndim``) and the last column the alive flag. Rows with
+    alive 0 are holes whose contents are unspecified.
+    """
+    R = grid.nranks
+    axes = grid.axis_names
+    C = capacity
+    D = domain.ndim if ndim is None else ndim
+
+    def fn(state: MigrateState):
+        fused, free_stack, n_free = state
+        n, K = fused.shape
+        me = lax.axis_index(axes).astype(jnp.int32)
+        alive = fused[:, -1] > 0.5
+        dest = binning.rank_of_position(fused[:, :D], domain, grid)
+        leaving = alive & (dest != me)
+        # Sentinel R: holes and staying residents sort to the tail.
+        dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
+
+        # THE sort: stable (key, slot) pairs; counts via searchsorted on the
+        # sorted keys (segment_sum lowers to a ~37 ms scatter-add at 4M).
+        iota = jnp.arange(n, dtype=jnp.int32)
+        keys_sorted, order = lax.sort(
+            (dest_key, iota), num_keys=1, is_stable=True
+        )
+        bounds = jnp.searchsorted(
+            keys_sorted, jnp.arange(R + 1, dtype=jnp.int32), side="left"
+        ).astype(jnp.int32)
+        full_counts = bounds[1:] - bounds[:-1]  # [R] leavers per dest
+        send_counts = jnp.minimum(full_counts, C)
+        backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
+
+        # Send slot (d, c), c < send_counts[d], takes the c-th leaver for d;
+        # leavers beyond capacity keep their slots (alive stays 1 — backlog).
+        c_idx = jnp.arange(C, dtype=jnp.int32)
+        flat_c = jnp.tile(c_idx, R)
+        flat_d = jnp.repeat(jnp.arange(R, dtype=jnp.int32), C)
+        slot_valid = flat_c < send_counts[flat_d]
+        src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
+        gather_idx = order[src]  # [R*C] unique over valid slots
+        send = jnp.where(
+            slot_valid[:, None], jnp.take(fused, gather_idx, axis=0), 0.0
+        ).reshape(R, C, K)
+
+        recv_counts = lax.all_to_all(
+            send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+        )
+        recv = lax.all_to_all(
+            send, axes, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(R * C, K)
+
+        n_sent = jnp.sum(send_counts).astype(jnp.int32)
+        n_in = jnp.sum(recv_counts).astype(jnp.int32)
+
+        # Compact both sides by pure index arithmetic (no sort, no scatter):
+        # the k-th valid send slot / arrival lives in segment d = cum^-1(k).
+        cum_send = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_counts)]
+        )
+        cum_recv = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv_counts)]
+        )
+        k_idx = jnp.arange(R * C, dtype=jnp.int32)
+        d_of_k_send = _segment_of(k_idx, cum_send)
+        vacated = gather_idx[
+            jnp.minimum(
+                d_of_k_send * C + (k_idx - cum_send[d_of_k_send]), R * C - 1
+            )
+        ]  # [R*C]; first n_sent entries are the vacated slot ids
+        d_of_k_recv = _segment_of(k_idx, cum_recv)
+        arrivals = jnp.take(
+            recv,
+            jnp.minimum(
+                d_of_k_recv * C + (k_idx - cum_recv[d_of_k_recv]), R * C - 1
+            ),
+            axis=0,
+        )  # [R*C, K]; first n_in rows are real arrivals (alive column 1)
+
+        # Landing plan for write slot j in [R*C]:
+        #   j < min(n_in, n_sent): arrival j -> vacated[j]
+        #   n_sent <= j < n_in:    arrival j -> popped free slot
+        #   n_in <= j < n_sent:    hole marker -> vacated[j]
+        # Receiver overflow: arrivals beyond n_sent + n_free drop (counted).
+        n_pop = jnp.clip(n_in - n_sent, 0, n_free)
+        dropped_recv = jnp.maximum(n_in - n_sent - n_free, 0).astype(
+            jnp.int32
+        )
+        pop_idx = jnp.clip(n_free - 1 - (k_idx - n_sent), 0, n - 1)
+        target = jnp.where(
+            k_idx < jnp.minimum(n_in, n_sent),
+            vacated,
+            jnp.where(
+                (k_idx >= n_sent) & (k_idx < n_sent + n_pop),
+                free_stack[pop_idx],
+                jnp.where(
+                    (k_idx >= n_in) & (k_idx < n_sent),
+                    vacated,
+                    n,  # sentinel: dropped by mode="drop"
+                ),
+            ),
+        )
+        rows = jnp.where((k_idx < n_in)[:, None], arrivals, 0.0)
+        # THE scatter: payload + alive flag + hole markers in one pass.
+        fused = fused.at[target].set(rows, mode="drop")
+
+        # Free-stack update (contiguous window ops only). Net excess
+        # departures (n_sent - n_in when positive) were written as holes at
+        # vacated[n_in : n_sent]: push them. Pops just lower n_free.
+        n_push = jnp.maximum(n_sent - n_in, 0)
+        new_n_free = n_free - n_pop + n_push
+        # Blend the push window into the stack: read-modify-write of a
+        # static [R*C] window starting at n_free (dynamic_update_slice
+        # clamps the start so the window stays in bounds; compensate by
+        # addressing relative to the clamped start).
+        win_start = jnp.minimum(n_free, n - R * C) if n > R * C else 0
+        win_start = jnp.maximum(win_start, 0).astype(jnp.int32)
+        window = lax.dynamic_slice(free_stack, (win_start,), (min(R * C, n),))
+        rel = n_free - win_start  # position of the stack head in the window
+        w_idx = jnp.arange(min(R * C, n), dtype=jnp.int32)
+        pushes = vacated[jnp.clip(n_in + (w_idx - rel), 0, R * C - 1)]
+        window = jnp.where(
+            (w_idx >= rel) & (w_idx < rel + n_push), pushes, window
+        )
+        free_stack = lax.dynamic_update_slice(free_stack, window, (win_start,))
+
+        alive_new = fused[:, -1] > 0.5
+        population = jnp.sum(alive_new.astype(jnp.int32))
+        stats = MigrateStats(
+            sent=n_sent[None],
+            received=n_in[None],
+            population=population[None],
+            backlog=backlog[None],
+            dropped_recv=dropped_recv[None],
+        )
+        return MigrateState(fused, free_stack, new_n_free), stats
+
+    return fn
+
+
+def shard_migrate_fn(domain: Domain, grid: ProcessGrid, capacity: int):
+    """Per-field wrapper over the fused path (runs under ``shard_map``).
+
+    Signature of the returned fn:
+      ``(pos[n,D], alive[n] bool, *fields) ->
+        (pos, alive, *fields, MigrateStats)``
+    with identical shapes; rows where ``alive`` is False are holes. Fields
+    must have 32-bit dtypes (see :func:`fuse_fields`); loops should carry
+    :class:`MigrateState` across steps instead (see
+    ``models.nbody.make_migrate_loop``) to skip the per-step fuse/unfuse and
+    free-stack rebuild.
+    """
+    fused_fn = shard_migrate_fused_fn(domain, grid, capacity)
+
+    def fn(pos, alive, *fields):
+        fused, specs = fuse_fields((pos,) + tuple(fields), alive)
+        state, stats = fused_fn(init_state(fused))
+        out, alive_new = unfuse_fields(state.fused, specs)
+        return (out[0], alive_new) + tuple(out[1:]) + (stats,)
+
+    return fn

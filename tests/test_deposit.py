@@ -93,3 +93,35 @@ def test_deposit_rejects_nonperiodic():
 def test_deposit_rejects_indivisible_mesh():
     with pytest.raises(ValueError):
         deposit_lib.shard_deposit_fn(DOMAIN, GRID, (9, 8, 8))
+
+
+def test_masked_deposit_ignores_garbage_holes(rng, _devices):
+    """Dead slots may hold NaN/Inf bytes (migration holes); the masked
+    deposit must still produce a finite, mass-conserving mesh."""
+    import jax
+    from mpi_grid_redistribute_tpu.models import nbody
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    grid = ProcessGrid((2, 2, 2))
+    R = grid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = 32
+    n = R * n_local
+    mesh = mesh_lib.make_mesh(grid)
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=grid, dt=0.0, capacity=4, n_local=n_local,
+        deposit_shape=(4, 4, 4),
+    )
+    step = nbody.make_migrate_step(cfg, mesh)
+
+    pos = rng.random((n, 3), dtype=np.float32)
+    from mpi_grid_redistribute_tpu.ops import binning
+    dest = binning.rank_of_position(pos, domain, grid, xp=np)
+    alive = dest == np.repeat(np.arange(R), n_local)
+    pos[~alive] = np.nan  # garbage holes
+    vel = np.zeros((n, 3), dtype=np.float32)
+
+    out = jax.tree.map(np.asarray, step(pos, vel, alive))
+    rho = out[-1]
+    assert np.isfinite(rho).all()
+    assert np.isclose(rho.sum(), alive.sum(), rtol=1e-4)
