@@ -5,106 +5,180 @@ Prints ONE JSON line:
    "vs_baseline": N}
 
 North star (BASELINE.json / BASELINE.md): >=10x particles/sec vs 8-rank CPU
-MPI on the redistribute pipeline. mpi4py is not installed here (SURVEY.md §4),
-so the baseline denominator is the pure-NumPy 8-rank oracle — the same
-digitize -> histogram -> argsort pack -> Alltoallv-semantics exchange the MPI
-path runs, minus the wire (favorable to the baseline: zero comm cost).
-``vs_baseline`` is therefore (our aggregate particles/sec) / (8-rank CPU
-aggregate particles/sec); >=10 means the north star is met.
+MPI on the redistribute pipeline. mpi4py is not installed here (SURVEY.md
+§4), so the baseline denominator is the pure-NumPy 8-rank oracle — the same
+digitize -> pack -> Alltoallv-semantics exchange the MPI path runs, minus
+the wire (favorable to the baseline: zero comm cost). ``vs_baseline`` is
+(our aggregate particles/sec) / (8-rank CPU aggregate particles/sec); >=10
+means the north star is met.
 
-Shape of the timed run: the fused periodic drift step (drift + wrap + bin +
-pack + all_to_all + compact — SURVEY.md §3.3, the steady-state workload) on
-a 2x2x2 mesh when >=8 devices are visible, else on the single available chip.
+Workload: the periodic drift loop (SURVEY.md §3.3, the steady-state
+redistribution workload) over a 2x2x2 Cartesian grid of subdomains with
+particles genuinely crossing subdomain boundaries every step. On one chip
+the 8 subdomains run as virtual ranks (vmapped slabs + on-device exchange);
+with >=8 devices they run one per device with the all_to_all on the wire.
+Timing uses scan-compiled loops of two lengths and differences them, which
+cancels compile, dispatch and transfer overhead (the remote-tunnel TPU here
+has ~100 ms fixed round-trip latency that would otherwise swamp the signal).
 
-Env overrides: BENCH_N_LOCAL (particles per chip), BENCH_STEPS (timed steps),
-BENCH_BASELINE_N (CPU-oracle particle count).
+Env overrides: BENCH_N_LOCAL (particles per subdomain), BENCH_MIGRATION
+(target per-step migration fraction, default 0.02 — a
+generous rate for drift steps, which move particles well under a cell width), BENCH_S1/BENCH_S2
+(loop lengths), BENCH_BASELINE_N (CPU-oracle total particles).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
 
 import numpy as np
 
+GRID = (2, 2, 2)
+R = 8
+
 
 def _stderr(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def time_device_pipeline(devs, n_local_per_chip: int, n_steps: int):
+FILL = 0.9  # fraction of slots occupied; holes give arrival headroom
+
+
+def _initial_state(n_local: int, migration: float, rng):
+    """Uniform particles per slab (FILL fraction of slots; the rest are
+    holes, giving every slab arrival headroom) + velocities sized so
+    ~``migration`` of live rows cross a subdomain face per step (at dt=1)."""
+    n = R * n_local
+    pos = rng.random((n, 3), dtype=np.float32)
+    # slab s owns cell (i,j,k); remap x to each slab's subdomain
+    from mpi_grid_redistribute_tpu.domain import ProcessGrid
+
+    grid = ProcessGrid(GRID)
+    lo = np.zeros((n, 3), dtype=np.float32)
+    for s in range(R):
+        cell = grid.cell_of_rank(s)
+        for a in range(3):
+            lo[s * n_local : (s + 1) * n_local, a] = cell[a] / GRID[a]
+    pos = lo + pos / np.asarray(GRID, np.float32)
+    # mean |v_a| * dt / cell_width ~ migration/3 per axis (3 axes ~ target)
+    v_scale = (
+        migration / 3.0 * 2.0 / np.asarray(GRID, np.float32)
+    )  # per-axis cell width
+    vel = (v_scale * (rng.random((n, 3), dtype=np.float32) * 2.0 - 1.0)).astype(
+        np.float32
+    )
+    alive = np.tile(np.arange(n_local) < int(FILL * n_local), R)
+    return pos, vel, alive
+
+
+def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     import jax
+    import jax.numpy as jnp
 
     from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
     from mpi_grid_redistribute_tpu.models import nbody
     from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
 
-    if len(devs) >= 8:
-        shape = (2, 2, 2)
-    else:
-        shape = (1, 1, 1)
-    grid = ProcessGrid(shape)
-    R = grid.nranks
+    devs = jax.devices()
     domain = Domain(0.0, 1.0, periodic=True)
-    mesh = mesh_lib.make_mesh(grid, devices=devs[:R])
+    if len(devs) >= R:
+        dev_grid, vgrid, n_chips = ProcessGrid(GRID), None, R
+        mesh = mesh_lib.make_mesh(dev_grid, devices=devs[:R])
+    else:
+        dev_grid, vgrid, n_chips = (
+            ProcessGrid((1, 1, 1)),
+            ProcessGrid(GRID),
+            1,
+        )
+        mesh = mesh_lib.make_mesh(dev_grid, devices=devs[:1])
+
+    # capacity per (source, dest) pair: migrants spread over the distinct
+    # face neighbors (periodic axes of extent 2 wrap +1 and -1 to the SAME
+    # neighbor, doubling that pair's traffic); modest headroom — spikes
+    # backlog harmlessly and retry next step
+    distinct = sum(1 if g == 2 else 2 for g in GRID)
+    cap = max(64, math.ceil(FILL * n_local * migration / distinct * 1.3))
     cfg = nbody.DriftConfig(
-        domain=domain,
-        grid=grid,
-        dt=0.01,
-        capacity=max(1, n_local_per_chip // max(1, R)),
-        n_local=n_local_per_chip,
+        domain=domain, grid=dev_grid, dt=1.0, capacity=cap, n_local=n_local
     )
-    step = nbody.make_drift_step(cfg, mesh)
 
     rng = np.random.default_rng(0)
-    n = R * n_local_per_chip
-    pos = rng.random((n, 3), dtype=np.float32)
-    vel = (0.2 * (rng.random((n, 3), dtype=np.float32) - 0.5)).astype(
-        np.float32
+    pos, vel, alive = _initial_state(n_local, migration, rng)
+    pos, vel, alive = (
+        jax.device_put(jnp.asarray(pos)),
+        jax.device_put(jnp.asarray(vel)),
+        jax.device_put(jnp.asarray(alive)),
     )
-    count = np.full((R,), n_local_per_chip, dtype=np.int32)
 
-    t0 = time.perf_counter()
-    out = step(pos, vel, count)
-    jax.block_until_ready(out)
-    _stderr(f"compile+first step: {time.perf_counter() - t0:.1f}s")
-    pos_d, vel_d, count_d = out[0], out[1], out[2]
+    loops = {
+        S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid)
+        for S in (s1, s2)
+    }
 
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        pos_d, vel_d, count_d, _stats = step(pos_d, vel_d, count_d)
-    jax.block_until_ready((pos_d, vel_d, count_d))
-    dt = (time.perf_counter() - t0) / n_steps
-    total_particles = R * n_local_per_chip
-    return total_particles / dt, R, dt
+    def run(S):
+        loop = loops[S]
+        t0 = time.perf_counter()
+        out = loop(pos, vel, alive)
+        np.asarray(out[2])
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = loop(pos, vel, alive)
+        np.asarray(out[2])
+        return time.perf_counter() - t0, out[3], compile_s
+
+    t1, _, c1 = run(s1)
+    t1 = min(t1, run(s1)[0])
+    t2, stats, _ = run(s2)
+    t2 = min(t2, run(s2)[0])
+    per_step = (t2 - t1) / (s2 - s1)
+    sent = np.asarray(stats.sent).sum(axis=1)
+    backlog = np.asarray(stats.backlog).sum()
+    dropped = np.asarray(stats.dropped_recv).sum()
+    total = int(FILL * n_local) * R
+    _stderr(
+        f"device: {n_chips} chip(s), grid {GRID}"
+        + (f" as vranks {vgrid.shape}" if vgrid else "")
+        + f", n/slab={n_local}, cap/pair={cap}, first compile {c1:.0f}s"
+    )
+    _stderr(
+        f"  per-step {per_step*1e3:.2f} ms; migration/step "
+        f"{sent.mean()/total:.3%} (backlog {backlog}, dropped {dropped})"
+    )
+    if dropped:
+        _stderr("  WARNING: arrivals dropped — raise slab headroom")
+    return total / per_step, n_chips
 
 
-def time_cpu_oracle(n_total: int, n_steps: int):
-    """8-rank pure-NumPy oracle: the CPU-MPI stand-in (no wire cost)."""
+def time_cpu_oracle(n_total: int, migration: float, n_steps: int = 5):
+    """8-rank pure-NumPy oracle drift loop: the CPU-MPI stand-in."""
     from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
     from mpi_grid_redistribute_tpu import oracle
 
-    grid = ProcessGrid((2, 2, 2))
-    R = grid.nranks
+    grid = ProcessGrid(GRID)
     domain = Domain(0.0, 1.0, periodic=True)
     n_local = n_total // R
-    cap = max(1, n_local // R)
+    cap = n_local
     rng = np.random.default_rng(0)
-    pos = rng.random((R * n_local, 3), dtype=np.float32)
-    vel = 0.2 * (rng.random((R * n_local, 3), dtype=np.float32) - 0.5)
+    pos, vel, _ = _initial_state(n_local, migration, rng)
+    # same FILL as the device run: keep only the live prefix per slab
+    n_live = int(FILL * n_local)
+    keep = np.tile(np.arange(n_local) < n_live, R)
+    pos, vel = pos[keep], vel[keep]
+    n_local = n_live
     count = np.full((R,), n_local, dtype=np.int32)
-    dt_drift = np.float32(0.01)
 
     def one_step(pos, vel, count):
-        pos = (pos + vel * dt_drift) % np.float32(1.0)
+        pos = (pos + vel * np.float32(1.0)) % np.float32(1.0)
         pos, count, (vel,), _stats = oracle.redistribute_oracle_padded(
             domain, grid, pos, count, [vel], cap, n_local
         )
         return pos, vel, count
 
-    pos, vel, count = one_step(pos, vel, count)  # warm caches
+    pos, vel, count = one_step(pos, vel, count)  # warm
     t0 = time.perf_counter()
     for _ in range(n_steps):
         pos, vel, count = one_step(pos, vel, count)
@@ -115,27 +189,20 @@ def time_cpu_oracle(n_total: int, n_steps: int):
 def main() -> None:
     import jax
 
-    devs = jax.devices()
-    platform = devs[0].platform
-    on_tpu = platform not in ("cpu",)
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
     n_local = int(
-        os.environ.get("BENCH_N_LOCAL", 2**22 if on_tpu else 2**16)
+        os.environ.get("BENCH_N_LOCAL", 2**20 if on_tpu else 2**14)
     )
-    n_steps = int(os.environ.get("BENCH_STEPS", 10))
+    migration = float(os.environ.get("BENCH_MIGRATION", 0.02))
+    s1 = int(os.environ.get("BENCH_S1", 8))
+    s2 = int(os.environ.get("BENCH_S2", 72))
     baseline_n = int(os.environ.get("BENCH_BASELINE_N", 2**21))
 
-    _stderr(
-        f"devices: {len(devs)} x {platform}; n_local/chip={n_local}, "
-        f"steps={n_steps}"
-    )
-    pps, n_chips, step_dt = time_device_pipeline(devs, n_local, n_steps)
+    pps, n_chips = time_device_pipeline(n_local, migration, s1, s2)
     pps_per_chip = pps / n_chips
-    _stderr(
-        f"device pipeline: {pps:.3e} particles/s aggregate on {n_chips} "
-        f"chip(s) ({step_dt*1e3:.2f} ms/step)"
-    )
+    _stderr(f"device pipeline: {pps:.3e} particles/s aggregate")
 
-    cpu_pps = time_cpu_oracle(baseline_n, max(2, n_steps // 3))
+    cpu_pps = time_cpu_oracle(baseline_n, migration)
     _stderr(f"8-rank CPU oracle baseline: {cpu_pps:.3e} particles/s")
 
     print(

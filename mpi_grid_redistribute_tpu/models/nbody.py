@@ -159,7 +159,12 @@ def make_migrate_step(cfg: DriftConfig, mesh: Mesh):
     )
 
 
-def make_migrate_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
+def make_migrate_loop(
+    cfg: DriftConfig,
+    mesh: Mesh,
+    n_steps: int,
+    vgrid: Optional[ProcessGrid] = None,
+):
     """S fast-migration steps in one compiled program via ``lax.scan``.
 
     ``loop(pos, vel, alive) -> (pos, vel, alive, stats)`` with stats leaves
@@ -170,12 +175,31 @@ def make_migrate_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
     velocity columns), fused once on entry and split once on exit, so each
     step moves migrants with a single gather/all_to_all/scatter
     (:mod:`..parallel.migrate`).
+
+    With ``vgrid``, each device hosts ``V = vgrid.nranks`` subdomain slabs
+    of the full ``cfg.grid.shape * vgrid.shape`` grid (virtual ranks —
+    oversubscription). Global row layout is then device-major:
+    device d's rows hold its V slabs consecutively, ``n_local`` rows each,
+    and ``cfg.capacity`` bounds migrants per (source vrank, destination
+    global rank) pair. Deposit is not yet supported with vranks.
     """
     mesh_lib.validate_mesh_for_grid(mesh, cfg.grid)
     axes = cfg.grid.axis_names
     spec = P(axes)
     D = cfg.domain.ndim
-    mig = migrate.shard_migrate_fused_fn(cfg.domain, cfg.grid, cfg.capacity)
+    V = 1 if vgrid is None else vgrid.nranks
+    if vgrid is None:
+        mig = migrate.shard_migrate_fused_fn(
+            cfg.domain, cfg.grid, cfg.capacity
+        )
+    else:
+        if cfg.deposit_shape is not None:
+            raise NotImplementedError(
+                "CIC deposit with virtual ranks is not supported yet"
+            )
+        mig = migrate.shard_migrate_vranks_fn(
+            cfg.domain, cfg.grid, vgrid, cfg.capacity
+        )
     dep_fn = None
     if cfg.deposit_shape is not None:
         dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
@@ -184,6 +208,8 @@ def make_migrate_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
 
     def shard_loop(pos, vel, alive):
         fused, specs = migrate.fuse_fields((pos, vel), alive)
+        if vgrid is not None:
+            fused = fused.reshape(V, -1, fused.shape[1])
         state = migrate.init_state(fused)
         # scan requires carry leaves already marked device-varying (some
         # init_state outputs are iota-derived and start unvaried)
@@ -195,14 +221,17 @@ def make_migrate_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
 
         def body(state, _):
             f = state.fused
-            p = f[:, :D] + f[:, D : 2 * D] * jnp.asarray(cfg.dt, f.dtype)
+            p = f[..., :D] + f[..., D : 2 * D] * jnp.asarray(cfg.dt, f.dtype)
             p = binning.wrap_periodic(p, cfg.domain)
-            f = jnp.concatenate([p, f[:, D:]], axis=1)
+            f = jnp.concatenate([p, f[..., D:]], axis=-1)
             state, stats = mig(state._replace(fused=f))
             return state, stats
 
         state, stats = lax.scan(body, state, None, length=n_steps)
-        (pos_f, vel_f), alive_f = migrate.unfuse_fields(state.fused, specs)
+        fused_f = state.fused
+        if vgrid is not None:
+            fused_f = fused_f.reshape(-1, fused_f.shape[-1])
+        (pos_f, vel_f), alive_f = migrate.unfuse_fields(fused_f, specs)
         if dep_fn is None:
             return pos_f, vel_f, alive_f, stats
         rho = dep_fn(pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), alive_f)

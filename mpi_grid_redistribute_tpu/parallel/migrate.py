@@ -28,6 +28,14 @@ Design (one compiled step, all static shapes):
   6. arrivals beyond the shard's free slots are counted in ``dropped_recv``
      (receiver overflow is the only loss channel, and it is surfaced).
 
+**Virtual ranks** (:func:`shard_migrate_vranks_fn`): each device can host a
+whole sub-grid of subdomains ("vranks", vmapped slabs), so a 4x4x4 grid runs
+on 8 chips — or on one — with identical semantics: the per-vrank pack/land
+phases vmap, and the cross-device hop is one ``lax.all_to_all`` on the
+``[D, V_src, V_dst, C, K]`` buffer; vrank-to-vrank traffic on the same
+device never leaves HBM. This is the TPU answer to running an R-rank MPI
+job on fewer nodes (SURVEY.md §2 process-grid topology, §7.6 scale).
+
 Slot order is *not* the MPI canonical order — arrivals fill arbitrary holes.
 Correctness is therefore set-equality per shard against the oracle (tested),
 not bit-equality; use :mod:`exchange` when canonical order matters.
@@ -35,7 +43,7 @@ not bit-equality; use :mod:`exchange` when canonical order matters.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Sequence, Tuple
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -47,10 +55,10 @@ from mpi_grid_redistribute_tpu.ops import binning
 
 class MigrateStats(NamedTuple):
     """Per-step migration observability (SURVEY.md §5.5). Global shapes [R]
-    (one entry per shard). ``backlog`` counts migrants delayed by per-pair
-    send capacity (they stay resident and retry); ``dropped_recv`` counts
-    arrivals lost to receiver free-slot exhaustion — surfaced, never
-    silent."""
+    (one entry per rank; with vranks, device-major ``dev * V + vrank``
+    order). ``backlog`` counts migrants delayed by per-pair send capacity
+    (they stay resident and retry); ``dropped_recv`` counts arrivals lost to
+    receiver free-slot exhaustion — surfaced, never silent."""
 
     sent: jax.Array
     received: jax.Array
@@ -62,9 +70,10 @@ class MigrateStats(NamedTuple):
 class MigrateState(NamedTuple):
     """Scan-carry state for the fused migration loop.
 
-    ``fused`` is ``[n, K]`` float32: position columns, payload columns, and
-    an alive column last. ``free_stack``/``n_free`` are the hole-slot stack
-    (indices of dead rows; only the first ``n_free`` entries are live)."""
+    ``fused`` is ``[n, K]`` float32 (``[V, n, K]`` with vranks): position
+    columns, payload columns, and an alive column last. ``free_stack`` /
+    ``n_free`` are the hole-slot stack (indices of dead rows; only the first
+    ``n_free`` entries are live)."""
 
     fused: jax.Array
     free_stack: jax.Array
@@ -119,9 +128,11 @@ def init_state(fused: jax.Array) -> MigrateState:
     """Build the free-slot stack from the fused matrix's alive column.
 
     One-time cost (a full argsort) at loop entry; the stack is maintained
-    incrementally afterwards.
+    incrementally afterwards. Works on ``[n, K]`` or vmapped ``[V, n, K]``.
     """
-    n = fused.shape[0]
+    if fused.ndim == 3:
+        states = jax.vmap(init_state)(fused)
+        return states
     alive = fused[:, -1] > 0.5
     # dead slots first, ascending slot order
     free_stack = jnp.argsort(
@@ -135,9 +146,127 @@ def _segment_of(k: jax.Array, cum: jax.Array) -> jax.Array:
     """For flat output position(s) ``k``, the segment index under exclusive
     cumulative counts ``cum`` ([R+1], cum[0]=0): the d with
     cum[d] <= k < cum[d+1]. Pure searchsorted — no scatter."""
-    return (
-        jnp.searchsorted(cum, k, side="right").astype(jnp.int32) - 1
+    return jnp.searchsorted(cum, k, side="right").astype(jnp.int32) - 1
+
+
+def _pack_leavers(fused, dest_key, n_dest: int, capacity: int):
+    """Sort-pack leaving rows into a ``[n_dest * C, K]`` send pool.
+
+    ``dest_key`` is the destination index per row with sentinel ``n_dest``
+    for rows that stay (resident, hole, or backlogged later). Returns
+    ``(send, send_counts, gather_idx, backlog)`` where ``send`` is zero in
+    invalid slots and ``gather_idx[j]`` is the resident row feeding send
+    slot ``j`` (unique over valid slots).
+    """
+    n, K = fused.shape
+    C = capacity
+    iota = jnp.arange(n, dtype=jnp.int32)
+    keys_sorted, order = lax.sort(
+        (dest_key, iota), num_keys=1, is_stable=True
     )
+    bounds = jnp.searchsorted(
+        keys_sorted, jnp.arange(n_dest + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    full_counts = bounds[1:] - bounds[:-1]  # [n_dest] leavers per dest
+    send_counts = jnp.minimum(full_counts, C)
+    backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
+
+    c_idx = jnp.arange(C, dtype=jnp.int32)
+    flat_c = jnp.tile(c_idx, n_dest)
+    flat_d = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), C)
+    slot_valid = flat_c < send_counts[flat_d]
+    src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
+    gather_idx = order[src]  # [n_dest*C] unique over valid slots
+    send = jnp.where(
+        slot_valid[:, None], jnp.take(fused, gather_idx, axis=0), 0.0
+    )
+    return send, send_counts, gather_idx, backlog
+
+
+def _land_arrivals(
+    fused,
+    free_stack,
+    n_free,
+    recv,
+    recv_counts,
+    send_counts,
+    gather_idx,
+    capacity: int,
+):
+    """Land compacted arrivals into vacated slots, then popped holes.
+
+    ``recv`` is the flat ``[n_src * C, K]`` arrival pool (per-source slots,
+    only the first ``recv_counts[s]`` of each source's ``C`` valid);
+    ``send_counts`` / ``gather_idx`` describe this shard's own sends, whose
+    slots are being vacated. One scatter writes arrivals, hole markers and
+    the alive column together. Returns
+    ``(fused, free_stack, n_free, n_in, dropped_recv)``.
+    """
+    n = fused.shape[0]
+    C = capacity
+    n_dest = send_counts.shape[0]
+    n_src = recv_counts.shape[0]
+    P = max(n_src, n_dest) * C  # write-plan length
+    n_sent = jnp.sum(send_counts).astype(jnp.int32)
+    n_in = jnp.sum(recv_counts).astype(jnp.int32)
+
+    cum_send = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_counts)]
+    )
+    cum_recv = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv_counts)]
+    )
+    k_idx = jnp.arange(P, dtype=jnp.int32)
+    d_of_k = _segment_of(k_idx, cum_send)
+    vacated = gather_idx[
+        jnp.clip(d_of_k * C + (k_idx - cum_send[d_of_k]), 0, n_dest * C - 1)
+    ]  # first n_sent entries: vacated slot ids
+    s_of_k = _segment_of(k_idx, cum_recv)
+    arrivals = jnp.take(
+        recv,
+        jnp.clip(s_of_k * C + (k_idx - cum_recv[s_of_k]), 0, n_src * C - 1),
+        axis=0,
+    )  # first n_in rows: real arrivals (alive column already 1)
+
+    # Write plan for slot j in [P]:
+    #   j < min(n_in, n_sent): arrival j -> vacated[j]
+    #   n_sent <= j < n_in:    arrival j -> popped free slot
+    #   n_in <= j < n_sent:    hole marker -> vacated[j]
+    # Receiver overflow: arrivals beyond n_sent + n_free drop (counted).
+    n_pop = jnp.clip(n_in - n_sent, 0, n_free)
+    dropped_recv = jnp.maximum(n_in - n_sent - n_free, 0).astype(jnp.int32)
+    pop_idx = jnp.clip(n_free - 1 - (k_idx - n_sent), 0, n - 1)
+    target = jnp.where(
+        k_idx < jnp.minimum(n_in, n_sent),
+        vacated,
+        jnp.where(
+            (k_idx >= n_sent) & (k_idx < n_sent + n_pop),
+            free_stack[pop_idx],
+            jnp.where((k_idx >= n_in) & (k_idx < n_sent), vacated, n),
+        ),
+    )
+    rows = jnp.where((k_idx < n_in)[:, None], arrivals, 0.0)
+    # THE scatter: payload + alive flag + hole markers in one pass.
+    fused = fused.at[target].set(rows, mode="drop")
+
+    # Free-stack update (contiguous window ops only). Net excess departures
+    # (n_sent - n_in when positive) were written as holes at
+    # vacated[n_in : n_sent]: push them. Pops just lower n_free.
+    n_push = jnp.maximum(n_sent - n_in, 0)
+    new_n_free = n_free - n_pop + n_push
+    W = min(P, n)
+    # Blend the push window into the stack: read-modify-write of a static
+    # [W] window whose start is clamped so it stays in bounds.
+    win_start = jnp.clip(n_free, 0, max(n - W, 0)).astype(jnp.int32)
+    window = lax.dynamic_slice(free_stack, (win_start,), (W,))
+    rel = n_free - win_start  # stack head position inside the window
+    w_idx = jnp.arange(W, dtype=jnp.int32)
+    pushes = vacated[jnp.clip(n_in + (w_idx - rel), 0, P - 1)]
+    window = jnp.where(
+        (w_idx >= rel) & (w_idx < rel + n_push), pushes, window
+    )
+    free_stack = lax.dynamic_update_slice(free_stack, window, (win_start,))
+    return fused, free_stack, new_n_free, n_in, dropped_recv
 
 
 def shard_migrate_fused_fn(
@@ -158,7 +287,7 @@ def shard_migrate_fused_fn(
 
     def fn(state: MigrateState):
         fused, free_stack, n_free = state
-        n, K = fused.shape
+        K = fused.shape[1]
         me = lax.axis_index(axes).astype(jnp.int32)
         alive = fused[:, -1] > 0.5
         dest = binning.rank_of_position(fused[:, :D], domain, grid)
@@ -166,122 +295,128 @@ def shard_migrate_fused_fn(
         # Sentinel R: holes and staying residents sort to the tail.
         dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
 
-        # THE sort: stable (key, slot) pairs; counts via searchsorted on the
-        # sorted keys (segment_sum lowers to a ~37 ms scatter-add at 4M).
-        iota = jnp.arange(n, dtype=jnp.int32)
-        keys_sorted, order = lax.sort(
-            (dest_key, iota), num_keys=1, is_stable=True
+        send, send_counts, gather_idx, backlog = _pack_leavers(
+            fused, dest_key, R, C
         )
-        bounds = jnp.searchsorted(
-            keys_sorted, jnp.arange(R + 1, dtype=jnp.int32), side="left"
-        ).astype(jnp.int32)
-        full_counts = bounds[1:] - bounds[:-1]  # [R] leavers per dest
-        send_counts = jnp.minimum(full_counts, C)
-        backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
-
-        # Send slot (d, c), c < send_counts[d], takes the c-th leaver for d;
-        # leavers beyond capacity keep their slots (alive stays 1 — backlog).
-        c_idx = jnp.arange(C, dtype=jnp.int32)
-        flat_c = jnp.tile(c_idx, R)
-        flat_d = jnp.repeat(jnp.arange(R, dtype=jnp.int32), C)
-        slot_valid = flat_c < send_counts[flat_d]
-        src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
-        gather_idx = order[src]  # [R*C] unique over valid slots
-        send = jnp.where(
-            slot_valid[:, None], jnp.take(fused, gather_idx, axis=0), 0.0
-        ).reshape(R, C, K)
-
         recv_counts = lax.all_to_all(
             send_counts, axes, split_axis=0, concat_axis=0, tiled=True
         )
         recv = lax.all_to_all(
-            send, axes, split_axis=0, concat_axis=0, tiled=True
+            send.reshape(R, C, K), axes, split_axis=0, concat_axis=0,
+            tiled=True,
         ).reshape(R * C, K)
 
-        n_sent = jnp.sum(send_counts).astype(jnp.int32)
-        n_in = jnp.sum(recv_counts).astype(jnp.int32)
-
-        # Compact both sides by pure index arithmetic (no sort, no scatter):
-        # the k-th valid send slot / arrival lives in segment d = cum^-1(k).
-        cum_send = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_counts)]
+        fused, free_stack, n_free, n_in, dropped_recv = _land_arrivals(
+            fused, free_stack, n_free, recv, recv_counts, send_counts,
+            gather_idx, C,
         )
-        cum_recv = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv_counts)]
-        )
-        k_idx = jnp.arange(R * C, dtype=jnp.int32)
-        d_of_k_send = _segment_of(k_idx, cum_send)
-        vacated = gather_idx[
-            jnp.minimum(
-                d_of_k_send * C + (k_idx - cum_send[d_of_k_send]), R * C - 1
-            )
-        ]  # [R*C]; first n_sent entries are the vacated slot ids
-        d_of_k_recv = _segment_of(k_idx, cum_recv)
-        arrivals = jnp.take(
-            recv,
-            jnp.minimum(
-                d_of_k_recv * C + (k_idx - cum_recv[d_of_k_recv]), R * C - 1
-            ),
-            axis=0,
-        )  # [R*C, K]; first n_in rows are real arrivals (alive column 1)
-
-        # Landing plan for write slot j in [R*C]:
-        #   j < min(n_in, n_sent): arrival j -> vacated[j]
-        #   n_sent <= j < n_in:    arrival j -> popped free slot
-        #   n_in <= j < n_sent:    hole marker -> vacated[j]
-        # Receiver overflow: arrivals beyond n_sent + n_free drop (counted).
-        n_pop = jnp.clip(n_in - n_sent, 0, n_free)
-        dropped_recv = jnp.maximum(n_in - n_sent - n_free, 0).astype(
-            jnp.int32
-        )
-        pop_idx = jnp.clip(n_free - 1 - (k_idx - n_sent), 0, n - 1)
-        target = jnp.where(
-            k_idx < jnp.minimum(n_in, n_sent),
-            vacated,
-            jnp.where(
-                (k_idx >= n_sent) & (k_idx < n_sent + n_pop),
-                free_stack[pop_idx],
-                jnp.where(
-                    (k_idx >= n_in) & (k_idx < n_sent),
-                    vacated,
-                    n,  # sentinel: dropped by mode="drop"
-                ),
-            ),
-        )
-        rows = jnp.where((k_idx < n_in)[:, None], arrivals, 0.0)
-        # THE scatter: payload + alive flag + hole markers in one pass.
-        fused = fused.at[target].set(rows, mode="drop")
-
-        # Free-stack update (contiguous window ops only). Net excess
-        # departures (n_sent - n_in when positive) were written as holes at
-        # vacated[n_in : n_sent]: push them. Pops just lower n_free.
-        n_push = jnp.maximum(n_sent - n_in, 0)
-        new_n_free = n_free - n_pop + n_push
-        # Blend the push window into the stack: read-modify-write of a
-        # static [R*C] window starting at n_free (dynamic_update_slice
-        # clamps the start so the window stays in bounds; compensate by
-        # addressing relative to the clamped start).
-        win_start = jnp.minimum(n_free, n - R * C) if n > R * C else 0
-        win_start = jnp.maximum(win_start, 0).astype(jnp.int32)
-        window = lax.dynamic_slice(free_stack, (win_start,), (min(R * C, n),))
-        rel = n_free - win_start  # position of the stack head in the window
-        w_idx = jnp.arange(min(R * C, n), dtype=jnp.int32)
-        pushes = vacated[jnp.clip(n_in + (w_idx - rel), 0, R * C - 1)]
-        window = jnp.where(
-            (w_idx >= rel) & (w_idx < rel + n_push), pushes, window
-        )
-        free_stack = lax.dynamic_update_slice(free_stack, window, (win_start,))
-
-        alive_new = fused[:, -1] > 0.5
-        population = jnp.sum(alive_new.astype(jnp.int32))
+        population = jnp.sum((fused[:, -1] > 0.5).astype(jnp.int32))
         stats = MigrateStats(
-            sent=n_sent[None],
+            sent=jnp.sum(send_counts).astype(jnp.int32)[None],
             received=n_in[None],
             population=population[None],
             backlog=backlog[None],
             dropped_recv=dropped_recv[None],
         )
-        return MigrateState(fused, free_stack, new_n_free), stats
+        return MigrateState(fused, free_stack, n_free), stats
+
+    return fn
+
+
+def shard_migrate_vranks_fn(
+    domain: Domain,
+    dev_grid: ProcessGrid,
+    vgrid: ProcessGrid,
+    capacity: int,
+    ndim: int = None,
+):
+    """Migration over a ``dev_grid * vgrid`` process grid, vranks vmapped.
+
+    The full Cartesian grid has shape ``dev_grid.shape * vgrid.shape``
+    (elementwise): device cell ``i // v`` and vrank cell ``i % v`` per axis.
+    Each device owns ``V = vgrid.nranks`` subdomain slabs.
+
+    Signature of the returned per-shard fn:
+      ``MigrateState -> (MigrateState, MigrateStats)``
+    with ``state.fused [V, n, K]``, ``free_stack [V, n]``, ``n_free [V]``;
+    stats entries are ``[V]`` per device (global device-major order).
+    ``capacity`` bounds migrants per (source vrank, destination global
+    rank) pair.
+    """
+    axes = dev_grid.axis_names
+    V = vgrid.nranks
+    Dev = dev_grid.nranks
+    C = capacity
+    D = domain.ndim if ndim is None else ndim
+    full_shape = tuple(
+        d * v for d, v in zip(dev_grid.shape, vgrid.shape)
+    )
+    full_grid = ProcessGrid(full_shape, axis_names=dev_grid.axis_names)
+    R_total = Dev * V
+
+    def fn(state: MigrateState):
+        fused, free_stack, n_free = state  # [V, n, K], [V, n], [V]
+        K = fused.shape[2]
+        me_dev = lax.axis_index(axes).astype(jnp.int32)
+        my_v = jnp.arange(V, dtype=jnp.int32)  # vrank ids on this device
+
+        def bin_one(f, v_id):
+            alive = f[:, -1] > 0.5
+            cell = binning.cell_of_position(
+                binning.wrap_periodic(f[:, :D], domain), domain, full_grid
+            )
+            vshape = jnp.asarray(vgrid.shape, jnp.int32)
+            dev_cell = cell // vshape
+            v_cell = cell % vshape
+            dest_dev = binning.rank_of_cell(dev_cell, dev_grid)
+            dest_v = binning.rank_of_cell(v_cell, vgrid)
+            staying = (dest_dev == me_dev) & (dest_v == v_id)
+            leaving = alive & ~staying
+            # device-major global destination: dev * V + vrank
+            key = jnp.where(
+                leaving, dest_dev * V + dest_v, R_total
+            ).astype(jnp.int32)
+            return key
+
+        dest_key = jax.vmap(bin_one)(fused, my_v)  # [V, n]
+        send, send_counts, gather_idx, backlog = jax.vmap(
+            lambda f, k: _pack_leavers(f, k, R_total, C)
+        )(fused, dest_key)
+        # send: [V_src, R_total*C, K] -> [Dev, V_src, V_dst, C, K]
+        send = send.reshape(V, Dev, V, C, K).transpose(1, 0, 2, 3, 4)
+        counts_t = send_counts.reshape(V, Dev, V).transpose(1, 0, 2)
+        if Dev > 1:
+            recv = lax.all_to_all(
+                send, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+            recv_counts = lax.all_to_all(
+                counts_t, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+        else:
+            recv, recv_counts = send, counts_t
+        # recv: [Dev_src, V_src, V_dst, C, K] -> per dst vrank pools
+        recv = recv.transpose(2, 0, 1, 3, 4).reshape(V, Dev * V * C, K)
+        recv_counts = recv_counts.transpose(2, 0, 1).reshape(V, Dev * V)
+
+        fused, free_stack, n_free, n_in, dropped_recv = jax.vmap(
+            lambda f, fs, nf, rv, rc, sc, gi: _land_arrivals(
+                f, fs, nf, rv, rc, sc, gi, C
+            )
+        )(
+            fused, free_stack, n_free, recv, recv_counts, send_counts,
+            gather_idx,
+        )
+        population = jnp.sum(
+            (fused[:, :, -1] > 0.5).astype(jnp.int32), axis=1
+        )
+        stats = MigrateStats(
+            sent=jnp.sum(send_counts, axis=1).astype(jnp.int32),
+            received=n_in,
+            population=population,
+            backlog=backlog,
+            dropped_recv=dropped_recv,
+        )
+        return MigrateState(fused, free_stack, n_free), stats
 
     return fn
 

@@ -187,3 +187,70 @@ def test_migrate_backlog_drains(rng, _devices):
         moved += stats.sent.sum()
         assert a.sum() == total0
     assert moved == 16  # the full backlog drained at 4/step
+
+
+def _slab_full_ranks(dev_grid, vgrid):
+    """full-grid rank of each (device, vrank) slab, device-major order."""
+    full = ProcessGrid(
+        tuple(d * v for d, v in zip(dev_grid.shape, vgrid.shape))
+    )
+    out = []
+    for d in range(dev_grid.nranks):
+        dc = dev_grid.cell_of_rank(d)
+        for v in range(vgrid.nranks):
+            vc = vgrid.cell_of_rank(v)
+            cell = tuple(
+                dc[a] * vgrid.shape[a] + vc[a] for a in range(len(dc))
+            )
+            out.append(full.rank_of_cell(cell))
+    return full, np.asarray(out)
+
+
+@pytest.mark.parametrize(
+    "dev_shape,v_shape",
+    [((1, 1, 1), (2, 2, 2)), ((2, 2, 1), (1, 2, 2)), ((2, 1, 1), (2, 2, 1))],
+)
+def test_migrate_vranks_matches_reference_sets(dev_shape, v_shape, rng, _devices):
+    dev_grid = ProcessGrid(dev_shape)
+    vgrid = ProcessGrid(v_shape)
+    full, slab_rank = _slab_full_ranks(dev_grid, vgrid)
+    R = full.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = 64
+    n = R * n_local
+    mesh = mesh_lib.make_mesh(dev_grid)
+
+    pos = rng.random((n, 3), dtype=np.float32)
+    vel = (0.6 * (rng.random((n, 3), dtype=np.float32) - 0.5)).astype(
+        np.float32
+    )
+    alive = rng.random(n) > 0.125
+    # legal start: live rows sit on the slab owning their position
+    dest = binning.rank_of_position(pos, domain, full, xp=np)
+    slot_slab = np.repeat(slab_rank, n_local)  # device-major slabs
+    alive &= dest == slot_slab
+
+    n_steps = 5
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=0.07, capacity=n_local,
+        n_local=n_local,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, n_steps, vgrid=vgrid)
+    pos_f, vel_f, alive_f, stats = jax.tree.map(
+        np.asarray, loop(pos, vel, alive)
+    )
+
+    assert stats.backlog.sum() == 0
+    assert stats.dropped_recv.sum() == 0
+    assert alive_f.sum() == alive.sum()
+
+    dest_f = binning.rank_of_position(pos_f, domain, full, xp=np)
+    assert (dest_f[alive_f] == slot_slab[alive_f]).all()
+
+    want = _np_drift_reference(
+        domain, full, pos, vel, alive, np.float32(0.07), n_steps
+    )
+    for slab in range(R):
+        sl = slice(slab * n_local, (slab + 1) * n_local)
+        got = _rows_set(pos_f[sl], vel_f[sl], alive_f[sl])
+        assert got == want[slab_rank[slab]], f"slab {slab} mismatch"
