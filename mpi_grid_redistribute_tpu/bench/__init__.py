@@ -1,0 +1,14 @@
+"""Benchmark drivers for the five BASELINE.json configs (SURVEY.md §6).
+
+Each module has ``run(**overrides) -> dict`` and a CLI printing one JSON
+line, mirroring the repo-root ``bench.py`` contract:
+
+  * config1_oracle    — 1M uniform, 2x2x2: oracle equality + throughput
+  * config2_clustered — log-normal clustered, 4x4x4: load imbalance
+  * config3_slab      — 8x8 2D slab decomposition at scale
+  * config4_drift     — periodic drift loop, redistribute every step
+  * config5_deposit   — redistribute + CIC particle-mesh deposit fused
+
+Sizes default to what the local device can hold and scale with
+``BENCH_SCALE`` (1.0 = the BASELINE.json size where memory allows).
+"""

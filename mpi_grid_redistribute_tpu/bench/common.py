@@ -1,0 +1,94 @@
+"""Shared benchmark plumbing: device/vrank layout pick, sizing, reporting."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(result: dict) -> None:
+    """The one-JSON-line contract shared with the repo-root bench.py."""
+    print(json.dumps(result), flush=True)
+
+
+def pick_layout(grid_shape: Tuple[int, ...]):
+    """Map an R-rank Cartesian grid onto the available devices.
+
+    Returns ``(dev_grid, vgrid, mesh, n_chips)``: one rank per device when
+    enough devices exist; otherwise the whole grid runs as virtual-rank
+    slabs on one device (same semantics, on-device exchange).
+    """
+    import jax
+
+    devs = jax.devices()
+    grid = ProcessGrid(grid_shape)
+    if len(devs) >= grid.nranks:
+        mesh = mesh_lib.make_mesh(grid, devices=devs[: grid.nranks])
+        return grid, None, mesh, grid.nranks
+    dev_grid = ProcessGrid((1,) * len(grid_shape))
+    mesh = mesh_lib.make_mesh(dev_grid, devices=devs[:1])
+    return dev_grid, grid, mesh, 1
+
+
+def uniform_state(grid_shape, n_local: int, fill: float, rng, vel_scale=0.0):
+    """Uniform particles placed on their owning slab (device-major rows).
+
+    ``vel_scale`` may be a scalar or a per-axis array; velocities are drawn
+    uniform in ``[-vel_scale, vel_scale]`` per axis.
+    """
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    n = R * n_local
+    pos = rng.random((n, 3), dtype=np.float32)
+    lo = np.zeros((n, 3), dtype=np.float32)
+    for s in range(R):
+        cell = grid.cell_of_rank(s)
+        for a in range(3):
+            lo[s * n_local : (s + 1) * n_local, a] = (
+                cell[a] / grid.shape[a]
+            )
+    pos = lo + pos / np.asarray(grid.shape, np.float32)
+    vel = (
+        np.asarray(vel_scale, np.float32)
+        * (rng.random((n, 3), dtype=np.float32) * 2.0 - 1.0)
+    ).astype(np.float32)
+    alive = np.tile(np.arange(n_local) < int(fill * n_local), R)
+    return pos, vel, alive
+
+
+def lognormal_state(grid_shape, n_local: int, fill: float, rng, sigma=1.0):
+    """Log-normal clustered global positions (BASELINE config 2): heavy
+    density contrast across subdomains -> load imbalance. Rows are NOT
+    pre-placed on owners; the redistribute under test must move them."""
+    grid = ProcessGrid(grid_shape)
+    n = grid.nranks * n_local
+    raw = rng.lognormal(mean=0.0, sigma=sigma, size=(n, 3))
+    pos = (raw % 1.0).astype(np.float32)
+    alive = np.tile(np.arange(n_local) < int(fill * n_local), grid.nranks)
+    return pos, alive
+
+
+def timeit_fetch(fn, args, reps: int = 3) -> float:
+    """min wall seconds of fn(*args) with a host-fetch barrier."""
+    import jax
+
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best

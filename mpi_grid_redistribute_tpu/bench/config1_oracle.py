@@ -1,0 +1,72 @@
+"""Config 1 (BASELINE.json): 1M uniform particles, 2x2x2 grid — the
+correctness-oracle config. Runs the one-shot ``redistribute()`` on the JAX
+backend, proves bit-equality against the NumPy rank-simulation oracle
+(SURVEY.md §7.4), and reports JAX-path throughput.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu import GridRedistribute, Domain
+from mpi_grid_redistribute_tpu.bench import common
+
+
+def run(n_total: int = None, reps: int = 3) -> dict:
+    import jax
+
+    n_total = n_total or int(
+        float(os.environ.get("BENCH_SCALE", 1.0)) * (1 << 20)
+    )
+    grid_shape = (2, 2, 2)
+    R = 8
+    devs = jax.devices()
+    if len(devs) < R:
+        grid_shape = (1, 1, 1)
+        R = 1
+        common.log("config1: <8 devices, shrinking grid to 1 rank")
+    n_local = n_total // R
+    rng = np.random.default_rng(42)
+    pos = rng.random((R * n_local, 3), dtype=np.float32)
+    vel = rng.standard_normal((R * n_local, 3)).astype(np.float32)
+    ids = np.arange(R * n_local, dtype=np.int32)
+
+    kw = dict(
+        domain=None, lo=0.0, hi=1.0, periodic=True,
+        capacity_factor=4.0,
+    )
+    rd = GridRedistribute(grid=grid_shape, backend="jax", **kw)
+    res = rd.redistribute(pos, vel, ids)
+    rd_np = GridRedistribute(grid=grid_shape, backend="numpy", **kw)
+    res_np = rd_np.redistribute(pos, vel, ids)
+    bit_equal = (
+        np.asarray(res.positions).tobytes() == res_np.positions.tobytes()
+        and np.asarray(res.count).tobytes() == res_np.count.tobytes()
+        and all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(res.fields, res_np.fields)
+        )
+    )
+    if not bit_equal:
+        raise AssertionError("config1: JAX backend != oracle at bit level")
+
+    t = common.timeit_fetch(
+        lambda p: rd.redistribute(p, vel, ids).positions, (pos,), reps=reps
+    )
+    out = {
+        "metric": "config1_redistribute_pps",
+        "value": round(n_total / t, 2),
+        "unit": "particles/s",
+        "bit_equal_vs_oracle": True,
+        "n_total": n_total,
+        "ranks": R,
+    }
+    common.log(f"config1: {t*1e3:.1f} ms/call (incl. dispatch overhead)")
+    return out
+
+
+if __name__ == "__main__":
+    common.emit(run())

@@ -1,0 +1,74 @@
+"""Config 3 (BASELINE.json): 8x8 2D slab decomposition at scale.
+
+The grid is (8, 8, 1): z undecomposed — the reference's 2D pencil/slab mode
+(SURVEY.md C1). 64 slabs run one-per-device or as virtual ranks. The full
+BASELINE size (1B particles) needs a v5e-64 pod's aggregate HBM
+(SURVEY.md §7.6); ``BENCH_SCALE`` sizes the local stand-in, and the layout
+/ program are identical — pod runs are a config change only.
+
+Workload: drift loop at ~2% migration/step, as the headline bench.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.bench import common
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def run(n_local: int = None, migration: float = 0.02) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    scale = float(os.environ.get("BENCH_SCALE", 1.0))
+    n_local = n_local or max(1 << 12, int(scale * (1 << 17)))
+    grid_shape = (8, 8, 1)
+    dev_grid, vgrid, mesh, n_chips = common.pick_layout(grid_shape)
+    domain = Domain(0.0, 1.0, periodic=True)
+    rng = np.random.default_rng(3)
+    fill = 0.9
+    # velocities sized for ~`migration` fraction crossing per step (2
+    # decomposed axes of extent 8: 2 distinct neighbors each)
+    v_scale = migration / 2.0 * 2.0 / np.asarray(grid_shape, np.float32)
+    v_scale[2] = v_scale[0]  # z undecomposed: any speed, no migration
+    pos, _, alive = common.uniform_state(grid_shape, n_local, fill, rng)
+    vel = (
+        v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
+    ).astype(np.float32)
+    cap = max(64, math.ceil(fill * n_local * migration / 4.0 * 1.5))
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=1.0, capacity=cap, n_local=n_local
+    )
+    pos, vel, alive = (
+        jax.device_put(jnp.asarray(pos)),
+        jax.device_put(jnp.asarray(vel)),
+        jax.device_put(jnp.asarray(alive)),
+    )
+    per_step, _ = profiling.scan_time_per_step(
+        lambda S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid),
+        (pos, vel, alive),
+        s1=4,
+        s2=24,
+    )
+    total = int(fill * n_local) * 64
+    res = {
+        "metric": "config3_slab_pps_per_chip",
+        "value": round(total / per_step / n_chips, 2),
+        "unit": "particles/s",
+        "grid": "8x8 slab",
+        "n_total": total,
+        "chips": n_chips,
+        "ms_per_step": round(per_step * 1e3, 2),
+    }
+    common.log(f"config3: {per_step*1e3:.2f} ms/step, {total} particles")
+    return res
+
+
+if __name__ == "__main__":
+    common.emit(run())

@@ -1,0 +1,66 @@
+"""Config 4 (BASELINE.json): periodic N-body drift loop, redistribute every
+step — the strong-scaling config (SURVEY.md §3.3). This is the repo-root
+``bench.py`` headline workload; this driver re-exposes it in the config
+suite with its own knobs.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.bench import common
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    scale = float(os.environ.get("BENCH_SCALE", 1.0))
+    n_local = n_local or max(1 << 12, int(scale * (1 << 20)))
+    grid_shape = (2, 2, 2)
+    dev_grid, vgrid, mesh, n_chips = common.pick_layout(grid_shape)
+    domain = Domain(0.0, 1.0, periodic=True)
+    rng = np.random.default_rng(0)
+    fill = 0.9
+    v_scale = migration / 3.0 * 2.0 / np.asarray(grid_shape, np.float32)
+    pos, _, alive = common.uniform_state(grid_shape, n_local, fill, rng)
+    vel = (
+        v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
+    ).astype(np.float32)
+    distinct = sum(1 if g == 2 else 2 for g in grid_shape)
+    cap = max(64, math.ceil(fill * n_local * migration / distinct * 1.3))
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=1.0, capacity=cap, n_local=n_local
+    )
+    pos, vel, alive = (
+        jax.device_put(jnp.asarray(pos)),
+        jax.device_put(jnp.asarray(vel)),
+        jax.device_put(jnp.asarray(alive)),
+    )
+    per_step, _ = profiling.scan_time_per_step(
+        lambda S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid),
+        (pos, vel, alive),
+        s1=8,
+        s2=min(72, max(16, steps)),
+    )
+    total = int(fill * n_local) * 8
+    res = {
+        "metric": "config4_drift_pps_per_chip",
+        "value": round(total / per_step / n_chips, 2),
+        "unit": "particles/s",
+        "n_total": total,
+        "chips": n_chips,
+        "ms_per_step": round(per_step * 1e3, 2),
+    }
+    common.log(f"config4: {per_step*1e3:.2f} ms/step")
+    return res
+
+
+if __name__ == "__main__":
+    common.emit(run())

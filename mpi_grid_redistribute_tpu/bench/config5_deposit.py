@@ -1,0 +1,83 @@
+"""Config 5 (BASELINE.json): redistribute + CIC particle-mesh deposit fused
+(SURVEY.md §3.4). One jitted SPMD program per step: drift + wrap + exchange
++ scatter-add deposit + ppermute ghost fold.
+
+Runs the canonical :mod:`..parallel.exchange` path (Alltoallv-ordered) on
+the device grid (one rank per device; on a single chip the grid degenerates
+to one rank and the exchange is local — the CIC deposit, the hot op of this
+config, runs at full size either way). Vrank deposit assembly is future
+work (see models/nbody.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.bench import common
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def run(n_local: int = None, mesh_cells: int = 128) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    scale = float(os.environ.get("BENCH_SCALE", 1.0))
+    n_local = n_local or max(1 << 12, int(scale * (1 << 20)))
+    devs = jax.devices()
+    if len(devs) >= 8:
+        grid = ProcessGrid((2, 2, 2))
+    else:
+        grid = ProcessGrid((1, 1, 1))
+    mesh = mesh_lib.make_mesh(grid, devices=devs[: grid.nranks])
+    n_chips = grid.nranks
+    R = grid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    # density mesh cells per axis, rounded to divide over the grid
+    m = max(grid.shape) * max(1, mesh_cells // max(grid.shape))
+    dshape = (m, m, m)
+    cfg = nbody.DriftConfig(
+        domain=domain,
+        grid=grid,
+        dt=0.005,
+        capacity=max(64, n_local // 8),
+        n_local=n_local,
+        deposit_shape=dshape,
+    )
+    rng = np.random.default_rng(0)
+    n = R * n_local
+    pos = jax.device_put(jnp.asarray(rng.random((n, 3), dtype=np.float32)))
+    vel = jax.device_put(
+        jnp.asarray(
+            (0.1 * (rng.random((n, 3), dtype=np.float32) - 0.5)).astype(
+                np.float32
+            )
+        )
+    )
+    count = np.full((R,), n_local, dtype=np.int32)
+
+    per_step, _ = profiling.scan_time_per_step(
+        lambda S: nbody.make_drift_loop(cfg, mesh, S),
+        (pos, vel, count),
+        s1=4,
+        s2=16,
+    )
+    res = {
+        "metric": "config5_fused_deposit_pps_per_chip",
+        "value": round(n / per_step / n_chips, 2),
+        "unit": "particles/s",
+        "n_total": n,
+        "chips": n_chips,
+        "deposit_mesh": list(dshape),
+        "ms_per_step": round(per_step * 1e3, 2),
+    }
+    common.log(f"config5: {per_step*1e3:.2f} ms/step incl. CIC {dshape}")
+    return res
+
+
+if __name__ == "__main__":
+    common.emit(run())

@@ -64,6 +64,36 @@ def rank_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
     return rank_of_cell(cell_of_position(pos, domain, grid, xp=xp), grid, xp=xp)
 
 
+def sorted_dest_counts(dest, n_dest: int):
+    """Stable sort rows by destination AND count per destination, in one
+    ``lax.sort`` + ``searchsorted``.
+
+    On TPU, ``segment_sum`` histograms lower to a scatter-add (~37 ms at 4M
+    rows, measured) while a stable int32 key sort is ~6 ms and binary search
+    on the sorted keys is free — so the sort the pack needs anyway also
+    yields the histogram (SURVEY.md §7.3 steps 3-4 fused).
+
+    Args:
+      dest: [N] int32 destination per row; sentinel ``n_dest`` marks rows to
+        exclude (they sort to the tail and are not counted).
+      n_dest: number of destinations.
+
+    Returns:
+      (order, counts, bounds): ``order`` [N] — stable permutation grouping
+      rows by destination; ``counts`` [n_dest]; ``bounds`` [n_dest+1] —
+      start offset of each destination's segment in ``order``.
+    """
+    n = dest.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    keys_sorted, order = jax.lax.sort(
+        (dest, iota), num_keys=1, is_stable=True
+    )
+    bounds = jnp.searchsorted(
+        keys_sorted, jnp.arange(n_dest + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    return order, bounds[1:] - bounds[:-1], bounds
+
+
 def dest_histogram(dest, nranks: int, valid=None):
     """Per-destination send counts [nranks] (int32), JAX path.
 

@@ -46,6 +46,7 @@ def pack_by_destination(
     counts: jax.Array,
     arrays,
     capacity: int,
+    order: jax.Array = None,
 ):
     """Gather per-particle arrays into a ``[R, capacity, ...]`` send layout.
 
@@ -58,23 +59,31 @@ def pack_by_destination(
         stable prefix per destination.
       arrays: pytree of [N, ...] arrays sharing the leading axis.
       capacity: static slots per destination.
+      order: optional precomputed stable by-destination permutation (e.g.
+        from ``binning.sorted_dest_counts``, which yields the counts for
+        free from the same sort); computed here when omitted.
 
     Returns:
       pytree of [R, capacity, ...] arrays, zero in invalid slots.
     """
     R = counts.shape[0]
     n = dest.shape[0]
-    order = jnp.argsort(dest, stable=True)  # invalid (dest==R) land last
+    if order is None:
+        order = jnp.argsort(dest, stable=True)  # invalid (dest==R) last
     start = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
     )
     c_idx = jnp.arange(capacity, dtype=jnp.int32)
-    src_sorted = start[:, None] + c_idx[None, :]  # [R, C] index into sorted
-    slot_valid = c_idx[None, :] < jnp.minimum(counts, capacity)[:, None]
-    src_sorted = jnp.minimum(src_sorted, n - 1)
-    gather_idx = order[src_sorted]  # [R, C] index into original rows
+    # 1-D flat gather indices: 2-D index arrays lower to a slower gather.
+    flat_src = (start[:, None] + c_idx[None, :]).reshape(R * capacity)
+    slot_valid = (
+        c_idx[None, :] < jnp.minimum(counts, capacity)[:, None]
+    ).reshape(R * capacity)
+    gather_idx = order[jnp.minimum(flat_src, n - 1)]
     return jax.tree.map(
-        lambda a: _mask_rows(jnp.take(a, gather_idx, axis=0), slot_valid),
+        lambda a: _mask_rows(
+            jnp.take(a, gather_idx, axis=0), slot_valid
+        ).reshape((R, capacity) + a.shape[1:]),
         arrays,
     )
 

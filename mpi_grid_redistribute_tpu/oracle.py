@@ -25,6 +25,7 @@ import numpy as np
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.utils import native
 
 
 def redistribute_oracle(
@@ -100,6 +101,7 @@ def redistribute_oracle_padded(
     fields: Sequence[np.ndarray],
     capacity: int,
     out_capacity: int,
+    native_ok: bool = True,
 ):
     """Padded-layout oracle mirroring the JAX backend's exact semantics.
 
@@ -126,10 +128,24 @@ def redistribute_oracle_padded(
     send_rows: List[List[np.ndarray]] = []
     for s in range(R):
         sl = slice(s * n_local, s * n_local + int(counts[s]))
-        dest = binning.rank_of_position(np.asarray(pos[sl]), domain, grid, xp=np)
+        # C++ host runtime when built (utils/native: digitize + O(N+R)
+        # counting sort — the mpi4py/MPI-layer equivalent); transparent
+        # NumPy fallback, bit-identical either way. ``native_ok=False``
+        # pins the NumPy path — the reference-equivalent CPU pipeline a
+        # benchmark baseline should emulate.
+        if native_ok:
+            dest = native.bin_positions(np.asarray(pos[sl]), domain, grid)
+            dcounts, order = native.count_sort(dest, R)
+        else:
+            dest = binning.rank_of_position(
+                np.asarray(pos[sl]), domain, grid, xp=np
+            )
+            dcounts = np.bincount(dest, minlength=R + 1)[:R]
+            order = np.argsort(dest, kind="stable")
+        bounds = np.concatenate([[0], np.cumsum(dcounts)])
         rows = []
         for d in range(R):
-            idx = np.flatnonzero(dest == d) + s * n_local
+            idx = order[bounds[d] : bounds[d + 1]] + s * n_local
             if d != s:
                 # capacity bounds remote pairs only; self-owned rows never
                 # ride the wire in the JAX backend (pack.compact_with_self)

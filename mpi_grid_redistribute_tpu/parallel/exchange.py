@@ -67,13 +67,16 @@ def shard_redistribute_fn(
         # routes both invalid and self rows out of the remote pack.
         is_self = valid & (dest == me)
         dest_remote = jnp.where(is_self, R, dest)
-        remote_counts = binning.dest_histogram(dest_remote, R)
+        # One stable sort yields both the pack permutation and the
+        # per-destination counts (segment_sum histograms lower to a slow
+        # scatter-add on TPU — binning.sorted_dest_counts).
+        order, remote_counts, _ = binning.sorted_dest_counts(dest_remote, R)
         dropped_send = jnp.sum(jnp.maximum(remote_counts - capacity, 0))
         send_counts = jnp.minimum(remote_counts, capacity)
 
         arrays = (pos,) + tuple(fields)
         packed = pack.pack_by_destination(
-            dest_remote, remote_counts, arrays, capacity
+            dest_remote, remote_counts, arrays, capacity, order=order
         )
         recv_counts = lax.all_to_all(
             send_counts, axes, split_axis=0, concat_axis=0, tiled=True

@@ -160,14 +160,7 @@ def _pack_leavers(fused, dest_key, n_dest: int, capacity: int):
     """
     n, K = fused.shape
     C = capacity
-    iota = jnp.arange(n, dtype=jnp.int32)
-    keys_sorted, order = lax.sort(
-        (dest_key, iota), num_keys=1, is_stable=True
-    )
-    bounds = jnp.searchsorted(
-        keys_sorted, jnp.arange(n_dest + 1, dtype=jnp.int32), side="left"
-    ).astype(jnp.int32)
-    full_counts = bounds[1:] - bounds[:-1]  # [n_dest] leavers per dest
+    order, full_counts, bounds = binning.sorted_dest_counts(dest_key, n_dest)
     send_counts = jnp.minimum(full_counts, C)
     backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
 

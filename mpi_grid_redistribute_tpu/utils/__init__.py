@@ -1,0 +1,2 @@
+"""Auxiliary subsystems: checkpointing, profiling, observability
+(SURVEY.md §5)."""

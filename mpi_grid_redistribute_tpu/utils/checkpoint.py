@@ -1,0 +1,124 @@
+"""Checkpoint / resume for particle state (SURVEY.md §5.4).
+
+The reference has no checkpointing (MPI jobs fail-stop); the rebuild makes
+it trivial because the whole simulation state is a pytree of arrays. Two
+formats:
+
+  * ``save`` / ``load`` — one compressed ``.npz`` per shard plus a JSON
+    manifest, so an R-shard run restarts on a different device count (each
+    shard's rows are self-contained; SURVEY.md data layout: shard r owns
+    rows ``[r*n_local, (r+1)*n_local)``).
+  * ``save_orbax`` / ``load_orbax`` — thin orbax-checkpoint passthrough for
+    users already managing orbax state (kept optional; npz is the default
+    because it has zero deps and the state is plain arrays).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MANIFEST = "manifest.json"
+
+
+def save(
+    directory: str,
+    arrays: Dict[str, np.ndarray],
+    nranks: int,
+    step: int = 0,
+    extra: Optional[dict] = None,
+) -> None:
+    """Write one npz per shard + a manifest.
+
+    ``arrays`` maps names to global padded arrays whose leading dim divides
+    by ``nranks`` (the library's global layout) — or to [nranks]-shaped
+    per-shard scalars (e.g. ``count``), stored in the manifest shard files
+    as-is.
+    """
+    os.makedirs(directory, exist_ok=True)
+    rows = None
+    for name, a in arrays.items():
+        a = np.asarray(a)
+        if a.shape[0] == nranks and a.ndim == 1:
+            continue  # per-shard scalar vector
+        if a.shape[0] % nranks:
+            raise ValueError(
+                f"array {name!r} leading dim {a.shape[0]} does not divide "
+                f"over {nranks} shards"
+            )
+        r = a.shape[0] // nranks
+        if rows is None:
+            rows = r
+        elif rows != r:
+            raise ValueError(
+                f"array {name!r} has {r} rows/shard, expected {rows}"
+            )
+    if rows is None:
+        raise ValueError("no global arrays to checkpoint")
+    for rank in range(nranks):
+        shard = {}
+        for name, a in arrays.items():
+            a = np.asarray(a)
+            if a.shape[0] == nranks and a.ndim == 1:
+                shard[name] = a[rank : rank + 1]
+            else:
+                shard[name] = a[rank * rows : (rank + 1) * rows]
+        np.savez_compressed(
+            os.path.join(directory, f"shard_{rank:05d}.npz"), **shard
+        )
+    manifest = {
+        "nranks": nranks,
+        "rows_per_shard": rows,
+        "step": step,
+        "names": sorted(arrays.keys()),
+        "extra": extra or {},
+    }
+    with open(os.path.join(directory, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def load(
+    directory: str, ranks: Optional[Sequence[int]] = None
+) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Read shards back into global arrays. Returns ``(arrays, manifest)``.
+
+    ``ranks`` restricts loading to a subset of shards (concatenated in the
+    given order) — the resume path for re-decomposing onto a different
+    grid: load everything, then :func:`..api.redistribute` once.
+    """
+    with open(os.path.join(directory, _MANIFEST)) as f:
+        manifest = json.load(f)
+    nranks = manifest["nranks"]
+    if ranks is None:
+        ranks = range(nranks)
+    parts: Dict[str, list] = {}
+    for rank in ranks:
+        if not 0 <= rank < nranks:
+            raise ValueError(f"rank {rank} outside checkpoint of {nranks}")
+        with np.load(
+            os.path.join(directory, f"shard_{rank:05d}.npz")
+        ) as z:
+            for name in manifest["names"]:
+                parts.setdefault(name, []).append(z[name])
+    return {
+        name: np.concatenate(chunks, axis=0)
+        for name, chunks in parts.items()
+    }, manifest
+
+
+def save_orbax(path: str, pytree) -> None:
+    """Orbax passthrough (optional heavy dependency, kept at arm's length)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, pytree)
+
+
+def load_orbax(path: str):
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    return ckptr.restore(path)

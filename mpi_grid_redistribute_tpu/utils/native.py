@@ -1,0 +1,172 @@
+"""ctypes bindings for the C++ host runtime (native/ directory).
+
+The reference's native layer is MPI's C library plus mpi4py's Cython
+buffer packing (SURVEY.md §2); this module binds the rebuild's C++
+equivalent — digitize / counting-sort pack / row gather — for the CPU
+oracle and host-side tooling. pybind11 is not in this image, so the C ABI
++ ctypes is the binding (no build-time Python deps).
+
+The library auto-builds with g++ on first use when the .so is missing;
+every entry point has a NumPy fallback so the package works without a
+toolchain (``available()`` reports which path is live).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_NAME = "libgrid_redistribute_native.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _native_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "native",
+    )
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MPI_GRID_NO_NATIVE"):
+            return None
+        path = os.path.join(_native_dir(), _LIB_NAME)
+        if not os.path.exists(path):
+            build = os.path.join(_native_dir(), "build.sh")
+            if os.path.exists(build):
+                try:
+                    subprocess.run(
+                        [build], check=True, capture_output=True, timeout=120
+                    )
+                except (subprocess.SubprocessError, OSError):
+                    return None
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        if lib.grn_abi_version() != 1:
+            return None
+        lib.grn_bin.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.grn_count_sort.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.grn_gather_rows.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the C++ library is loaded (vs NumPy fallback)."""
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def bin_positions(pos: np.ndarray, domain, grid) -> np.ndarray:
+    """Destination rank per row — C++ twin of binning.rank_of_position."""
+    lib = _load()
+    if lib is None:
+        from mpi_grid_redistribute_tpu.ops import binning
+
+        return binning.rank_of_position(pos, domain, grid, xp=np)
+    pos = np.ascontiguousarray(pos, dtype=np.float32)
+    n, ndim = pos.shape
+    lo = np.asarray(domain.lo, dtype=np.float64)
+    hi = np.asarray(domain.hi, dtype=np.float64)
+    per = np.asarray(domain.periodic, dtype=np.int32)
+    gshape = np.asarray(grid.shape, dtype=np.int32)
+    dest = np.empty((n,), dtype=np.int32)
+    lib.grn_bin(
+        _ptr(pos, ctypes.c_float),
+        n,
+        ndim,
+        _ptr(lo, ctypes.c_double),
+        _ptr(hi, ctypes.c_double),
+        _ptr(per, ctypes.c_int32),
+        _ptr(gshape, ctypes.c_int32),
+        _ptr(dest, ctypes.c_int32),
+    )
+    return dest
+
+
+def count_sort(dest: np.ndarray, nranks: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(counts, stable order grouping rows by destination).
+
+    Sentinel ``nranks`` entries group at the tail and are not counted.
+    O(N + R) counting sort in C++; NumPy fallback uses bincount + stable
+    argsort.
+    """
+    lib = _load()
+    dest = np.ascontiguousarray(dest, dtype=np.int32)
+    if lib is None:
+        counts = np.bincount(
+            dest, minlength=nranks + 1
+        )[:nranks].astype(np.int64)
+        return counts, np.argsort(dest, kind="stable").astype(np.int64)
+    n = dest.shape[0]
+    counts = np.empty((nranks,), dtype=np.int64)
+    order = np.empty((n,), dtype=np.int64)
+    lib.grn_count_sort(
+        _ptr(dest, ctypes.c_int32),
+        n,
+        nranks,
+        _ptr(counts, ctypes.c_int64),
+        _ptr(order, ctypes.c_int64),
+    )
+    return counts, order
+
+
+def gather_rows(src: np.ndarray, order: np.ndarray) -> np.ndarray:
+    """out[j] = src[order[j]] — the pack gather, one memcpy pass in C++."""
+    lib = _load()
+    if lib is None:
+        return src[order]
+    src = np.ascontiguousarray(src)
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    out = np.empty((order.shape[0],) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize
+    for s in src.shape[1:]:
+        row_bytes *= s
+    lib.grn_gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        _ptr(order, ctypes.c_int64),
+        order.shape[0],
+        row_bytes,
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out

@@ -1,0 +1,97 @@
+"""Timing + tracing helpers (SURVEY.md §5.1).
+
+The driver metric is "particles redistributed/sec/chip; ICI all_to_all BW
+utilization". Getting honest numbers on TPU needs care:
+
+  * dispatch is async — ``block_until_ready`` may return before remote
+    compute finishes on tunneled platforms; fetching a value to the host is
+    the only hard barrier;
+  * there is a fixed per-invocation overhead (observed ~100 ms round-trip
+    on the tunneled chip here) that swamps single-call timings.
+
+:func:`scan_time_per_step` therefore compiles the step into ``lax.scan``
+loops of two lengths and differences the wall times — compile, dispatch,
+transfer and fetch costs cancel, leaving pure per-step device time. This is
+the method bench.py uses; it is exposed here for users profiling their own
+configurations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Tuple
+
+import jax
+import numpy as np
+
+
+def fetch_barrier(pytree) -> None:
+    """Hard barrier: force one device value to the host."""
+    leaves = jax.tree.leaves(pytree)
+    if leaves:
+        np.asarray(jax.tree.map(lambda a: a.ravel()[0], leaves[0]))
+
+
+def scan_time_per_step(
+    make_loop: Callable[[int], Callable],
+    args,
+    s1: int = 8,
+    s2: int = 72,
+    reps: int = 2,
+) -> Tuple[float, float]:
+    """Per-step seconds of ``make_loop(S)(*args)`` via length differencing.
+
+    ``make_loop(S)`` must return a jitted callable running S steps (e.g.
+    ``lambda S: nbody.make_migrate_loop(cfg, mesh, S)``). Returns
+    ``(per_step_seconds, fixed_overhead_seconds)``; the latter is the
+    per-invocation cost the differencing removed (useful to sanity-check
+    the method: it should dwarf neither measurement). The long loop's
+    output pytree is kept on ``scan_time_per_step.last_output`` so callers
+    can inspect stats without paying another invocation.
+    """
+    if s2 <= s1:
+        raise ValueError(f"need s2 > s1 for differencing, got {s1} >= {s2}")
+    loops = {s: make_loop(s) for s in (s1, s2)}
+
+    def run(s: int) -> float:
+        out = loops[s](*args)
+        fetch_barrier(out)  # warm: compile + first run
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = loops[s](*args)
+            fetch_barrier(out)
+            best = min(best, time.perf_counter() - t0)
+        scan_time_per_step.last_output = out
+        return best
+
+    t1, t2 = run(s1), run(s2)
+    per_step = (t2 - t1) / (s2 - s1)
+    return per_step, t1 - per_step * s1
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``jax.profiler.trace`` wrapper producing a Perfetto/XProf trace.
+
+    Remember to end the traced region with a :func:`fetch_barrier` so the
+    device timeline is complete before the trace closes.
+    """
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def exchange_bytes_per_step(stats, row_bytes: int) -> float:
+    """Mean bytes crossing the exchange per step, from a stats pytree.
+
+    Works for both ``RedistributeStats`` (send_counts [S?, R, R]) and
+    ``MigrateStats`` (sent [S, R]); multiply by achieved step rate for
+    wire bandwidth, compare against ICI line rate for utilization.
+    """
+    if hasattr(stats, "sent"):
+        sent = np.asarray(stats.sent)
+    else:
+        sent = np.asarray(stats.send_counts)
+    per_step = sent.reshape(sent.shape[0], -1).sum(axis=-1)
+    return float(per_step.mean()) * row_bytes

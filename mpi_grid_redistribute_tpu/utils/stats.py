@@ -1,0 +1,69 @@
+"""Structured per-step observability (SURVEY.md §5.5).
+
+The reference logs with rank-0 ``print``; here every exchange returns a
+stats pytree (``RedistributeStats`` / ``MigrateStats``) and this module
+turns those into structured summaries: totals, load imbalance, overflow
+counters — the numbers an operator actually watches (SURVEY.md §5.3:
+overflow must be surfaced, never silent).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def _imbalance(per_rank: np.ndarray) -> float:
+    """max/mean load ratio (1.0 = perfectly balanced); 0 if empty."""
+    m = per_rank.mean()
+    return float(per_rank.max() / m) if m > 0 else 0.0
+
+
+def summarize_redistribute(stats) -> Dict[str, float]:
+    """Summary dict from a ``RedistributeStats`` (optionally step-stacked)."""
+    send = np.asarray(stats.send_counts)
+    recv = np.asarray(stats.recv_counts)
+    send2 = send.reshape(-1, send.shape[-2], send.shape[-1])
+    recv2 = recv.reshape(-1, recv.shape[-2], recv.shape[-1])
+    moved = send2.sum(axis=(1, 2)) - np.einsum("sii->s", send2)
+    return {
+        "steps": send2.shape[0],
+        "total_rows": float(send2.sum(axis=(1, 2)).mean()),
+        "moved_rows": float(moved.mean()),
+        "recv_imbalance": _imbalance(recv2.sum(axis=2).mean(axis=0)),
+        "dropped_send": int(np.asarray(stats.dropped_send).sum()),
+        "dropped_recv": int(np.asarray(stats.dropped_recv).sum()),
+    }
+
+
+def summarize_migrate(stats) -> Dict[str, float]:
+    """Summary dict from a ``MigrateStats`` (optionally step-stacked)."""
+    sent = np.asarray(stats.sent).reshape(-1, np.asarray(stats.sent).shape[-1])
+    pop = np.asarray(stats.population).reshape(sent.shape)
+    return {
+        "steps": sent.shape[0],
+        "population": float(pop.sum(axis=1).mean()),
+        "sent_per_step": float(sent.sum(axis=1).mean()),
+        "migration_fraction": float(
+            sent.sum(axis=1).mean() / max(pop.sum(axis=1).mean(), 1.0)
+        ),
+        "population_imbalance": _imbalance(pop.mean(axis=0)),
+        "backlog": int(np.asarray(stats.backlog).sum()),
+        "dropped_recv": int(np.asarray(stats.dropped_recv).sum()),
+    }
+
+
+def check_no_loss(stats) -> None:
+    """Raise if any surfaced overflow counter is nonzero."""
+    problems = []
+    for name in ("dropped_send", "dropped_recv", "backlog"):
+        if hasattr(stats, name):
+            v = int(np.asarray(getattr(stats, name)).sum())
+            if v and name != "backlog":
+                problems.append(f"{name}={v}")
+    if problems:
+        raise RuntimeError(
+            "particle loss detected: " + ", ".join(problems)
+            + " — raise capacity / out_capacity / slab headroom"
+        )

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the host-runtime shared library next to this script.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -shared -fPIC -o libgrid_redistribute_native.so \
+    grid_redistribute_native.cpp
+echo "built native/libgrid_redistribute_native.so"

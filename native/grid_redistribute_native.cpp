@@ -1,0 +1,107 @@
+// Host-side native runtime for the CPU/oracle path.
+//
+// The reference's only native code is the MPI C library reached through
+// mpi4py's buffer-protocol packing (SURVEY.md §2 "Native components" —
+// reference mount empty, spec from BASELINE.json). This module is the
+// rebuild's host-runtime equivalent: the digitize -> per-destination count
+// -> stable counting-sort pack pipeline (SURVEY.md §3.2 hot path) in C++,
+// exposed through a plain C ABI for ctypes (no pybind11 in this image).
+//
+// The counting sort is O(N + R) and cache-friendly — it replaces the
+// O(N log N) np.argsort in the NumPy oracle, which both speeds up the
+// correctness oracle at scale and strengthens the CPU baseline the TPU
+// path is measured against (an honest comparison beats a weak one).
+//
+// Build: native/build.sh (g++ -O3 -shared -fPIC).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Map positions to flat row-major destination ranks.
+//
+//   pos        [n * ndim] float32, row-major
+//   lo, hi     [ndim] float64 domain bounds (Python floats)
+//   periodic   [ndim] int32 flags
+//   gshape     [ndim] int32 grid extents
+//   dest       [n] int32 output
+//
+// Bit-identical to ops/binning.py rank_of_position's float32 path: the
+// NumPy code derives extent and 1/width in FLOAT64 from the Python-float
+// bounds and only then casts to float32, so this does too; all
+// per-particle arithmetic is then pure float32.
+void grn_bin(const float* pos, int64_t n, int32_t ndim, const double* lo,
+             const double* hi, const int32_t* periodic,
+             const int32_t* gshape, int32_t* dest) {
+  std::vector<float> lo_f(ndim), extent_f(ndim), inv_w_f(ndim);
+  std::vector<int32_t> stride(ndim);
+  int32_t acc = 1;
+  for (int32_t a = ndim - 1; a >= 0; --a) {
+    lo_f[a] = static_cast<float>(lo[a]);
+    extent_f[a] = static_cast<float>(hi[a] - lo[a]);
+    inv_w_f[a] =
+        static_cast<float>(static_cast<double>(gshape[a]) / (hi[a] - lo[a]));
+    stride[a] = acc;
+    acc *= gshape[a];
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t r = 0;
+    for (int32_t a = 0; a < ndim; ++a) {
+      float x = pos[i * ndim + a];
+      if (periodic[a]) {
+        // match numpy float32 remainder (result carries divisor's sign)
+        float w = std::fmod(x - lo_f[a], extent_f[a]);
+        if (w < 0.0f) w += extent_f[a];
+        float wrapped = lo_f[a] + w;
+        if (wrapped >= lo_f[a] + extent_f[a]) wrapped = lo_f[a];
+        x = wrapped;
+      }
+      int32_t c =
+          static_cast<int32_t>(std::floor((x - lo_f[a]) * inv_w_f[a]));
+      if (c < 0) c = 0;
+      if (c >= gshape[a]) c = gshape[a] - 1;
+      r += c * stride[a];
+    }
+    dest[i] = r;
+  }
+}
+
+// Per-destination histogram + stable counting-sort permutation.
+//
+//   dest    [n] int32 destination per row; entries == nranks are invalid
+//           (padding) and grouped at the tail
+//   counts  [nranks] int64 output
+//   order   [n] int64 output: stable permutation grouping rows by dest
+void grn_count_sort(const int32_t* dest, int64_t n, int32_t nranks,
+                    int64_t* counts, int64_t* order) {
+  // Out-of-range destinations (negative or > nranks) are folded into the
+  // sentinel bucket nranks — grouped at the tail and uncounted, so garbage
+  // input degrades like the NumPy fallback instead of corrupting the heap.
+  auto bucket = [nranks](int32_t d) -> int32_t {
+    return (d < 0 || d > nranks) ? nranks : d;
+  };
+  std::vector<int64_t> c(nranks + 1, 0);
+  for (int64_t i = 0; i < n; ++i) c[bucket(dest[i])]++;
+  for (int32_t r = 0; r < nranks; ++r) counts[r] = c[r];
+  std::vector<int64_t> offset(nranks + 2, 0);
+  for (int32_t r = 0; r <= nranks; ++r) offset[r + 1] = offset[r] + c[r];
+  std::vector<int64_t> cursor(offset.begin(), offset.end() - 1);
+  for (int64_t i = 0; i < n; ++i) order[cursor[bucket(dest[i])]++] = i;
+}
+
+// Gather rows: out[j] = src[order[j]] for row_bytes-wide rows.
+// The pack step of the exchange (and the mpi4py buffer-assembly
+// equivalent): one pass, memcpy per row.
+void grn_gather_rows(const char* src, const int64_t* order, int64_t n_rows,
+                     int64_t row_bytes, char* out) {
+  for (int64_t j = 0; j < n_rows; ++j) {
+    std::memcpy(out + j * row_bytes, src + order[j] * row_bytes, row_bytes);
+  }
+}
+
+int32_t grn_abi_version() { return 1; }
+
+}  // extern "C"
