@@ -47,6 +47,7 @@ def run(n_local: int = None, mesh_cells: int = 128) -> dict:
         capacity=max(64, n_local // 8),
         n_local=n_local,
         deposit_shape=dshape,
+        deposit_method="scan",  # scatter-free deposit (ops/deposit.py)
     )
     rng = np.random.default_rng(0)
     n = R * n_local
@@ -61,7 +62,7 @@ def run(n_local: int = None, mesh_cells: int = 128) -> dict:
     count = np.full((R,), n_local, dtype=np.int32)
 
     per_step, _ = profiling.scan_time_per_step(
-        lambda S: nbody.make_drift_loop(cfg, mesh, S),
+        lambda S: nbody.make_drift_loop(cfg, mesh, S, deposit_each_step=True),
         (pos, vel, count),
         s1=4,
         s2=16,

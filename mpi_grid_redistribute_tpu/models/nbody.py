@@ -39,6 +39,7 @@ class DriftConfig:
     capacity: int
     n_local: int  # padded rows per shard; also the out_capacity
     deposit_shape: Optional[Tuple[int, ...]] = None  # global CIC mesh cells
+    deposit_method: str = "segment"  # "segment" (exact f32) | "scan" (fast)
 
 
 def make_drift_step(cfg: DriftConfig, mesh: Mesh):
@@ -57,7 +58,8 @@ def make_drift_step(cfg: DriftConfig, mesh: Mesh):
     dep_fn = None
     if cfg.deposit_shape is not None:
         dep_fn, _ = deposit_lib.shard_deposit_fn(
-            cfg.domain, cfg.grid, cfg.deposit_shape
+            cfg.domain, cfg.grid, cfg.deposit_shape,
+            method=cfg.deposit_method,
         )
 
     def shard_step(pos, vel, count):
@@ -85,30 +87,48 @@ def make_drift_step(cfg: DriftConfig, mesh: Mesh):
     )
 
 
-def make_drift_loop(cfg: DriftConfig, mesh: Mesh, n_steps: int):
+def make_drift_loop(
+    cfg: DriftConfig,
+    mesh: Mesh,
+    n_steps: int,
+    deposit_each_step: bool = False,
+):
     """S steps in one compiled program via ``lax.scan``.
 
     Returns ``loop(pos, vel, count) -> (pos, vel, count, stats)`` where
     stats leaves are stacked per step ([S, ...]); with a deposit mesh
-    configured, the *final* step's density is also returned (keeping only
-    the last avoids an S-times-larger live buffer).
+    configured, the *final* step's density is also returned. By default the
+    deposit runs once, on the final state (keeping only the last avoids an
+    S-times-larger live buffer); ``deposit_each_step=True`` runs it inside
+    every scanned step (the config-5 "fused every step" workload), carrying
+    only the latest mesh.
     """
     step = make_drift_step(
-        dataclasses.replace(cfg, deposit_shape=None), mesh
+        dataclasses.replace(
+            cfg,
+            deposit_shape=cfg.deposit_shape if deposit_each_step else None,
+        ),
+        mesh,
     )
     dep = None
-    if cfg.deposit_shape is not None:
+    if cfg.deposit_shape is not None and not deposit_each_step:
         dep = build_deposit_step(cfg, mesh)
 
     def loop(pos, vel, count):
         def body(carry, _):
-            p, v, c = carry
-            p, v, c, stats = step(p, v, c)
-            return (p, v, c), stats
+            p, v, c = carry[:3]
+            out = step(p, v, c)
+            p, v, c, stats = out[:4]
+            new_carry = (p, v, c) + ((out[4],) if len(out) > 4 else ())
+            return new_carry, stats
 
-        (pos_f, vel_f, count_f), stats = lax.scan(
-            body, (pos, vel, count), None, length=n_steps
-        )
+        init = (pos, vel, count)
+        if deposit_each_step:
+            init = init + (jnp.zeros(cfg.deposit_shape, jnp.float32),)
+        carry, stats = lax.scan(body, init, None, length=n_steps)
+        pos_f, vel_f, count_f = carry[:3]
+        if deposit_each_step:
+            return pos_f, vel_f, count_f, stats, carry[3]
         if dep is None:
             return pos_f, vel_f, count_f, stats
         rho = dep(pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), count_f)
@@ -135,7 +155,8 @@ def make_migrate_step(cfg: DriftConfig, mesh: Mesh):
     dep_fn = None
     if cfg.deposit_shape is not None:
         dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
-            cfg.domain, cfg.grid, cfg.deposit_shape
+            cfg.domain, cfg.grid, cfg.deposit_shape,
+            method=cfg.deposit_method,
         )
 
     def shard_step(pos, vel, alive):
@@ -203,7 +224,8 @@ def make_migrate_loop(
     dep_fn = None
     if cfg.deposit_shape is not None:
         dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
-            cfg.domain, cfg.grid, cfg.deposit_shape
+            cfg.domain, cfg.grid, cfg.deposit_shape,
+            method=cfg.deposit_method,
         )
 
     def shard_loop(pos, vel, alive):
@@ -257,7 +279,8 @@ def build_deposit_masked(cfg: DriftConfig, mesh: Mesh):
     if cfg.deposit_shape is None:
         raise ValueError("cfg.deposit_shape is required for deposit")
     fn, _ = deposit_lib.shard_deposit_fn_masked(
-        cfg.domain, cfg.grid, cfg.deposit_shape
+        cfg.domain, cfg.grid, cfg.deposit_shape,
+        method=cfg.deposit_method,
     )
     axes = cfg.grid.axis_names
     spec = P(axes)
@@ -272,5 +295,6 @@ def build_deposit_step(cfg: DriftConfig, mesh: Mesh):
     if cfg.deposit_shape is None:
         raise ValueError("cfg.deposit_shape is required for deposit")
     return deposit_lib.build_deposit(
-        mesh, cfg.domain, cfg.grid, cfg.deposit_shape
+        mesh, cfg.domain, cfg.grid, cfg.deposit_shape,
+        method=cfg.deposit_method,
     )
