@@ -103,6 +103,8 @@ def make_drift_loop(
     every scanned step (the config-5 "fused every step" workload), carrying
     only the latest mesh.
     """
+    if deposit_each_step and cfg.deposit_shape is None:
+        raise ValueError("cfg.deposit_shape is required for deposit")
     step = make_drift_step(
         dataclasses.replace(
             cfg,
