@@ -114,6 +114,11 @@ class GridRedistribute:
         cap = self.capacity
         if cap is None:
             cap = max(1, math.ceil(n_local / self.nranks * self.capacity_factor))
+            # Bucket derived capacities to the next power of two: clustered
+            # or growing workloads then re-trigger compilation only on
+            # bucket crossings, not on every new (n_local, capacity) pair
+            # (SURVEY.md §7.6 "measured capacity + recompile-on-growth").
+            cap = 1 << (cap - 1).bit_length()
         cap = min(cap, n_local)  # can never send more than n_local to one dest
         out_cap = n_local if self.out_capacity is None else self.out_capacity
         return cap, out_cap

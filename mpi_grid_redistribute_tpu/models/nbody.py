@@ -204,7 +204,8 @@ def make_migrate_loop(
     oversubscription). Global row layout is then device-major:
     device d's rows hold its V slabs consecutively, ``n_local`` rows each,
     and ``cfg.capacity`` bounds migrants per (source vrank, destination
-    global rank) pair. Deposit is not yet supported with vranks.
+    global rank) pair; CIC deposit assembles per-vrank blocks on device
+    (deposit_lib.shard_deposit_vranks_fn).
     """
     mesh_lib.validate_mesh_for_grid(mesh, cfg.grid)
     axes = cfg.grid.axis_names
@@ -216,19 +217,21 @@ def make_migrate_loop(
             cfg.domain, cfg.grid, cfg.capacity
         )
     else:
-        if cfg.deposit_shape is not None:
-            raise NotImplementedError(
-                "CIC deposit with virtual ranks is not supported yet"
-            )
         mig = migrate.shard_migrate_vranks_fn(
             cfg.domain, cfg.grid, vgrid, cfg.capacity
         )
     dep_fn = None
     if cfg.deposit_shape is not None:
-        dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
-            cfg.domain, cfg.grid, cfg.deposit_shape,
-            method=cfg.deposit_method,
-        )
+        if vgrid is None:
+            dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
+                cfg.domain, cfg.grid, cfg.deposit_shape,
+                method=cfg.deposit_method,
+            )
+        else:
+            dep_fn = deposit_lib.shard_deposit_vranks_fn(
+                cfg.domain, cfg.grid, vgrid, cfg.deposit_shape,
+                method=cfg.deposit_method,
+            )
 
     def shard_loop(pos, vel, alive):
         fused, specs = migrate.fuse_fields((pos, vel), alive)
@@ -258,7 +261,17 @@ def make_migrate_loop(
         (pos_f, vel_f), alive_f = migrate.unfuse_fields(fused_f, specs)
         if dep_fn is None:
             return pos_f, vel_f, alive_f, stats
-        rho = dep_fn(pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), alive_f)
+        if vgrid is None:
+            rho = dep_fn(
+                pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), alive_f
+            )
+        else:
+            pv = pos_f.reshape(V, -1, pos_f.shape[-1])
+            rho = dep_fn(
+                pv,
+                jnp.ones(pv.shape[:2], pos_f.dtype),
+                alive_f.reshape(V, -1),
+            )
         return pos_f, vel_f, alive_f, stats, rho
 
     # stats leaves are [S, 1] per shard (scan-stacked): shard axis 1.

@@ -294,6 +294,100 @@ def shard_deposit_fn(
     return fn, local_shape
 
 
+def shard_deposit_vranks_fn(
+    domain: Domain,
+    dev_grid: ProcessGrid,
+    vgrid: ProcessGrid,
+    mesh_shape: Tuple[int, ...],
+    method: str = "segment",
+):
+    """Per-device CIC deposit for virtual-rank state (``[V, n, K]`` slabs).
+
+    Each vrank deposits its slab onto its own +1-ghost block; the V ghost
+    blocks are then assembled onto the device's +1-ghost mesh with static
+    overlapping placements (vrank ghost faces fall on the neighboring
+    vrank's interior — on-device adds, no collective), and only the
+    device-level ghost faces cross the mesh via the usual
+    :func:`fold_ghosts` ``ppermute``.
+
+    Signature: ``(pos[V,n,D], mass[V,n], valid[V,n] bool) ->
+    rho_local[dev_block_shape]``.
+    """
+    full_shape = tuple(
+        d * v for d, v in zip(dev_grid.shape, vgrid.shape)
+    )
+    full_grid = ProcessGrid(full_shape, axis_names=dev_grid.axis_names)
+    _check_mesh_shape(domain, full_grid, mesh_shape)
+    if method not in ("segment", "scan"):
+        raise ValueError(f"method must be 'segment' or 'scan', got {method!r}")
+    deposit_impl = (
+        cic_deposit_local if method == "segment" else cic_deposit_local_sorted
+    )
+    ndim = domain.ndim
+    V = vgrid.nranks
+    dev_block = tuple(
+        m // g for m, g in zip(mesh_shape, dev_grid.shape)
+    )
+    vblock = tuple(b // v for b, v in zip(dev_block, vgrid.shape))
+    inv_h = jnp.asarray(
+        [m / e for m, e in zip(mesh_shape, domain.extent)], jnp.float32
+    )
+    vwidths = full_grid.cell_widths(domain)
+
+    def fn(pos, mass, valid):
+        me_cell = [
+            lax.axis_index(name).astype(jnp.int32)
+            for name in dev_grid.axis_names
+        ]
+
+        def one_vrank(pos_v, mass_v, valid_v, v_id):
+            vc = []
+            rem = v_id
+            for s in _pystrides(vgrid.shape):
+                vc.append(rem // s)
+                rem = rem % s
+            lo_local = jnp.stack(
+                [
+                    jnp.asarray(domain.lo[a], jnp.float32)
+                    + (
+                        me_cell[a] * vgrid.shape[a] + vc[a]
+                    ).astype(jnp.float32)
+                    * jnp.asarray(vwidths[a], jnp.float32)
+                    for a in range(ndim)
+                ]
+            )
+            return deposit_impl(
+                pos_v, mass_v, valid_v, lo_local, inv_h, vblock
+            )
+
+        rho_v = jax.vmap(one_vrank)(
+            pos, mass, valid, jnp.arange(V, dtype=jnp.int32)
+        )  # [V, *(vblock+1)]
+
+        # assemble: vrank (i,j,k)'s ghost block overlaps its +1 neighbors
+        total = jnp.zeros(
+            tuple(b + 1 for b in dev_block), dtype=rho_v.dtype
+        )
+        for v in range(V):
+            vc = vgrid.cell_of_rank(v)
+            idx = tuple(
+                slice(c * b, c * b + b + 1) for c, b in zip(vc, vblock)
+            )
+            total = total.at[idx].add(rho_v[v])
+        return fold_ghosts(total, dev_grid)
+
+    return fn
+
+
+def _pystrides(shape):
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    return list(reversed(strides))
+
+
 def build_deposit(
     mesh: Mesh,
     domain: Domain,

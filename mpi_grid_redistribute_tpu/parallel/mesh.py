@@ -55,6 +55,57 @@ def near_cubic_shape(n: int, ndim: int = 3) -> Tuple[int, ...]:
     return tuple(sorted(shape, reverse=True))
 
 
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host bring-up: ``jax.distributed.initialize`` passthrough.
+
+    Where the reference relies on ``mpirun`` to spawn and wire R processes
+    (SURVEY.md §3.1 "MPI already launched"), a multi-host TPU job runs one
+    process per host and calls this once before any device use; coordinator
+    address / process ids come from the TPU pod metadata automatically, or
+    from the standard kwargs (coordinator_address, num_processes,
+    process_id). Safe to call on a single host (no-op failure is raised by
+    JAX only when misconfigured).
+    """
+    import jax
+
+    jax.distributed.initialize(**kwargs)
+
+
+def make_hybrid_mesh(
+    grid: ProcessGrid, dcn_shape: Sequence[int] = None
+) -> Mesh:
+    """Mesh for multi-slice / multi-host jobs: ICI inside a slice, DCN
+    across slices.
+
+    ``dcn_shape[a]`` is how many slices the grid axis ``a`` spans (1 =
+    axis stays inside a slice). Collectives along intra-slice axes ride
+    ICI; only axes split across slices touch DCN — lay out the grid so the
+    high-traffic axes stay intra-slice (scaling-book recipe). With
+    ``dcn_shape=None`` or all-ones this reduces to :func:`make_mesh` with
+    XLA's bandwidth-aware device ordering.
+    """
+    from jax.experimental import mesh_utils
+
+    if dcn_shape is None:
+        dcn_shape = (1,) * grid.ndim
+    dcn_shape = tuple(int(d) for d in dcn_shape)
+    if len(dcn_shape) != grid.ndim:
+        raise ValueError(
+            f"dcn_shape must have {grid.ndim} axes, got {dcn_shape}"
+        )
+    for a, (g, d) in enumerate(zip(grid.shape, dcn_shape)):
+        if g % d:
+            raise ValueError(
+                f"axis {a}: grid extent {g} not divisible by dcn {d}"
+            )
+    if all(d == 1 for d in dcn_shape):
+        devices = mesh_utils.create_device_mesh(grid.shape)
+    else:
+        ici = tuple(g // d for g, d in zip(grid.shape, dcn_shape))
+        devices = mesh_utils.create_hybrid_device_mesh(ici, dcn_shape)
+    return Mesh(devices, grid.axis_names)
+
+
 def validate_mesh_for_grid(mesh: Mesh, grid: ProcessGrid) -> None:
     if tuple(mesh.axis_names) != tuple(grid.axis_names):
         raise ValueError(

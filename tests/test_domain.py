@@ -60,3 +60,18 @@ def test_neighbor_rank_periodic_and_edge():
 def test_grid_domain_ndim_mismatch():
     with pytest.raises(ValueError):
         ProcessGrid((2, 2)).validate_against(Domain(0.0, 1.0))
+
+
+def test_make_hybrid_mesh_single_slice(_devices):
+    """All-ones dcn_shape: bandwidth-aware single-slice mesh."""
+    from mpi_grid_redistribute_tpu.domain import ProcessGrid
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    grid = ProcessGrid((2, 2, 2))
+    mesh = mesh_lib.make_hybrid_mesh(grid)
+    assert tuple(mesh.devices.shape) == (2, 2, 2)
+    mesh_lib.validate_mesh_for_grid(mesh, grid)
+    import pytest
+
+    with pytest.raises(ValueError, match="divisible"):
+        mesh_lib.make_hybrid_mesh(grid, dcn_shape=(3, 1, 1))
