@@ -85,8 +85,12 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     # backlog harmlessly and retry next step
     distinct = sum(1 if g == 2 else 2 for g in GRID)
     cap = max(64, math.ceil(FILL * n_local * migration / distinct * 1.3))
+    # on-device routing budget: total migrants per vrank-step + headroom
+    # (compact routing costs scale with this, not with R*cap)
+    budget = max(256, math.ceil(FILL * n_local * migration * 1.3))
     cfg = nbody.DriftConfig(
-        domain=domain, grid=dev_grid, dt=1.0, capacity=cap, n_local=n_local
+        domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
+        n_local=n_local, local_budget=budget,
     )
 
     rng = np.random.default_rng(0)

@@ -40,6 +40,10 @@ class DriftConfig:
     n_local: int  # padded rows per shard; also the out_capacity
     deposit_shape: Optional[Tuple[int, ...]] = None  # global CIC mesh cells
     deposit_method: str = "segment"  # "segment" (exact f32) | "scan" (fast)
+    # on-device migrant budget per (vrank, step) for the vrank migrate
+    # path's compact routing (None -> V * capacity); see
+    # parallel.migrate.shard_migrate_vranks_fn
+    local_budget: Optional[int] = None
 
 
 def make_drift_step(cfg: DriftConfig, mesh: Mesh):
@@ -218,7 +222,8 @@ def make_migrate_loop(
         )
     else:
         mig = migrate.shard_migrate_vranks_fn(
-            cfg.domain, cfg.grid, vgrid, cfg.capacity
+            cfg.domain, cfg.grid, vgrid, cfg.capacity,
+            local_budget=cfg.local_budget,
         )
     dep_fn = None
     if cfg.deposit_shape is not None:

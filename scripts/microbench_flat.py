@@ -1,0 +1,85 @@
+"""vmapped [V,P] vs flat [V*P] row scatter/gather into [V,n,K] vs [V*n,K]."""
+import sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from mpi_grid_redistribute_tpu.utils import profiling
+
+V, N, K = 8, 2**20, 7
+
+
+def timed(name, make_loop, *args, s1=4, s2=24):
+    per_step, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    print(f"  {name:44s} {per_step*1e3:8.3f} ms", file=sys.stderr)
+
+
+def run(P):
+    rng = np.random.default_rng(0)
+    arr = jax.device_put(jnp.asarray(rng.random((V, N, K), dtype=np.float32)))
+    arrf = jax.device_put(jnp.asarray(rng.random((V * N, K), dtype=np.float32)))
+    idx = jax.device_put(jnp.asarray(rng.integers(0, N, size=(V, P), dtype=np.int32)))
+    idxf = jax.device_put(jnp.asarray(rng.integers(0, V * N, size=(V * P,), dtype=np.int32)))
+    rows = jax.device_put(jnp.asarray(rng.random((V, P, K), dtype=np.float32)))
+    rowsf = rows.reshape(V * P, K)
+
+    def mk_vmap_scatter(S):
+        @jax.jit
+        def loop(a, i):
+            def body(c, _):
+                a, i = c
+                a = jax.vmap(lambda aa, ii, rr: aa.at[ii].set(rr, mode="drop"))(a, i, rows)
+                a, i = lax.optimization_barrier((a, i))
+                i = (i + a[0, 0, 0].astype(jnp.int32) % 2) % N
+                return (a, i), ()
+            c, _ = lax.scan(body, (a, i), None, length=S)
+            return c
+        return loop
+
+    def mk_flat_scatter(S):
+        @jax.jit
+        def loop(a, i):
+            def body(c, _):
+                a, i = c
+                a = a.at[i].set(rowsf, mode="drop")
+                a, i = lax.optimization_barrier((a, i))
+                i = (i + a[0, 0].astype(jnp.int32) % 2) % (V * N)
+                return (a, i), ()
+            c, _ = lax.scan(body, (a, i), None, length=S)
+            return c
+        return loop
+
+    def mk_vmap_gather(S):
+        @jax.jit
+        def loop(a, i):
+            def body(c, _):
+                a, i = c
+                out = jax.vmap(lambda aa, ii: jnp.take(aa, ii, axis=0))(a, i)
+                a, i, out = lax.optimization_barrier((a, i, out))
+                i = (i + out[0, 0, 0].astype(jnp.int32) % 2) % N
+                return (a, i), ()
+            c, _ = lax.scan(body, (a, i), None, length=S)
+            return c
+        return loop
+
+    def mk_flat_gather(S):
+        @jax.jit
+        def loop(a, i):
+            def body(c, _):
+                a, i = c
+                out = jnp.take(a, i, axis=0)
+                a, i, out = lax.optimization_barrier((a, i, out))
+                i = (i + out[0, 0].astype(jnp.int32) % 2) % (V * N)
+                return (a, i), ()
+            c, _ = lax.scan(body, (a, i), None, length=S)
+            return c
+        return loop
+
+    timed(f"vmap scatter V={V} P={P}", mk_vmap_scatter, arr, idx)
+    timed(f"flat scatter {V*P} rows", mk_flat_scatter, arrf, idxf)
+    timed(f"vmap gather V={V} P={P}", mk_vmap_gather, arr, idx)
+    timed(f"flat gather {V*P} rows", mk_flat_gather, arrf, idxf)
+
+
+for P in (2**15, 65432):
+    run(P)

@@ -1,0 +1,113 @@
+"""Microbenchmarks: TPU row gather/scatter cost scaling.
+
+Questions that drive the migrate-path redesign (VERDICT round-1 item 2):
+  1. true cost of the pack gather / landing scatter (optimization_barrier
+     dependencies this time — profile_stages.py's ``*0`` trick folded away);
+  2. does gather/scatter cost scale with #rows touched (→ compact routing
+     wins) or with array size?
+  3. does row width (K) matter, or is cost per-row?
+  4. do sorted indices beat random ones?
+
+Usage: python scripts/microbench_gs.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.utils import profiling
+
+N = 2**20  # rows in the resident array
+
+
+def timed(name, make_loop, *args, s1=4, s2=24):
+    per_step, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    print(f"  {name:44s} {per_step*1e3:8.3f} ms", file=sys.stderr)
+    return per_step * 1e3
+
+
+def make_gather(P, K, sorted_idx=False):
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, N, size=(P,), dtype=np.int32)
+    if sorted_idx:
+        idx = np.sort(idx)
+    idx = jax.device_put(jnp.asarray(idx))
+    arr = jax.device_put(
+        jnp.asarray(rng.random((N, K), dtype=np.float32))
+    )
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx):
+            def body(carry, _):
+                a, i = carry
+                out = jnp.take(a, i, axis=0)
+                (a, i, out) = lax.optimization_barrier((a, i, out))
+                i = (i + out[0, 0].astype(jnp.int32) % 2) % N
+                return (a, i), ()
+
+            carry, _ = lax.scan(body, (arr, idx), None, length=S)
+            return carry
+
+        return loop
+
+    return make_loop, (arr, idx)
+
+
+def make_scatter(P, K, sorted_idx=False):
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, N, size=(P,), dtype=np.int32)
+    if sorted_idx:
+        idx = np.sort(idx)
+    idx = jax.device_put(jnp.asarray(idx))
+    arr = jax.device_put(jnp.asarray(rng.random((N, K), dtype=np.float32)))
+    rows = jax.device_put(jnp.asarray(rng.random((P, K), dtype=np.float32)))
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx, rows):
+            def body(carry, _):
+                a, i = carry
+                a = a.at[i].set(rows, mode="drop")
+                (a, i) = lax.optimization_barrier((a, i))
+                i = (i + a[0, 0].astype(jnp.int32) % 2) % N
+                return (a, i), ()
+
+            carry, _ = lax.scan(body, (arr, idx, rows)[:2], None, length=S)
+            return carry
+
+        return loop
+
+    return make_loop, (arr, idx, rows)
+
+
+def main():
+    results = {}
+    print("gather: rows P from [1M, K] array", file=sys.stderr)
+    for P in (2**14, 2**16, 2**18):
+        for K in (1, 7, 8, 32):
+            ml, args = make_gather(P, K)
+            results[f"gather P={P} K={K}"] = timed(
+                f"gather P={P:>6} K={K:>2} random", ml, *args
+            )
+    ml, args = make_gather(2**16, 8, sorted_idx=True)
+    timed("gather P= 65536 K= 8 SORTED", ml, *args)
+
+    print("scatter: rows P into [1M, K] array", file=sys.stderr)
+    for P in (2**14, 2**16, 2**18):
+        for K in (1, 7, 8, 32):
+            ml, args = make_scatter(P, K)
+            results[f"scatter P={P} K={K}"] = timed(
+                f"scatter P={P:>6} K={K:>2} random", ml, *args
+            )
+    ml, args = make_scatter(2**16, 8, sorted_idx=True)
+    timed("scatter P= 65536 K= 8 SORTED", ml, *args)
+
+
+if __name__ == "__main__":
+    main()

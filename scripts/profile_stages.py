@@ -1,0 +1,229 @@
+"""Per-stage device-time attribution for the headline migrate step.
+
+Times each pipeline stage of the vrank migrate step in isolation at
+bench-identical shapes (V vranks of n rows, K fused columns, per-pair
+capacity C), using the same scan-length-differencing as bench.py so the
+~100 ms tunnel round-trip cancels. Each stage's scan carries a data
+dependency through the timed op so XLA cannot hoist or DCE it.
+
+Usage:  python scripts/profile_stages.py [n_local] [capacity]
+
+Output: a markdown table of ms/step per stage; paste into README (VERDICT
+round-1 item 1: publish the stage table explaining where the step time
+goes).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.utils import profiling
+
+GRID = (2, 2, 2)
+V = 8
+R_TOTAL = 8
+K = 7  # pos(3) + vel(3) + alive(1)
+FILL = 0.9
+MIGRATION = 0.02
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2**20
+    import math
+
+    distinct = sum(1 if g == 2 else 2 for g in GRID)
+    C = (
+        int(sys.argv[2])
+        if len(sys.argv) > 2
+        else max(64, math.ceil(FILL * n * MIGRATION / distinct * 1.3))
+    )
+    domain = Domain(0.0, 1.0, periodic=True)
+    vgrid = ProcessGrid(GRID)
+    dev_grid = ProcessGrid((1, 1, 1))
+
+    rng = np.random.default_rng(0)
+    fused = rng.random((V, n, K), dtype=np.float32)
+    fused[:, :, -1] = (rng.random((V, n)) < FILL).astype(np.float32)
+    fused = jax.device_put(jnp.asarray(fused))
+    # a plausible dest_key distribution: mostly sentinel (stay), ~2% spread
+    # over the 3 distinct neighbors
+    key_np = np.full((V, n), R_TOTAL, np.int32)
+    m = int(n * FILL * MIGRATION)
+    for v in range(V):
+        idx = rng.choice(n, size=m, replace=False)
+        key_np[v, idx] = rng.choice([1, 2, 4], size=m)  # face neighbors of 0
+    dest_key = jax.device_put(jnp.asarray(key_np))
+    gather_idx = jax.device_put(
+        jnp.asarray(
+            rng.integers(0, n, size=(V, R_TOTAL * C), dtype=np.int32)
+        )
+    )
+    target = gather_idx
+    rows = jax.device_put(
+        jnp.asarray(
+            rng.random((V, R_TOTAL * C, K), dtype=np.float32)
+        )
+    )
+
+    stages = {}
+
+    def timed(name, make_loop, *args, s1=4, s2=24):
+        per_step, _ = profiling.scan_time_per_step(
+            make_loop, args, s1=s1, s2=s2
+        )
+        stages[name] = per_step * 1e3
+        print(f"  {name:30s} {per_step*1e3:8.2f} ms", file=sys.stderr)
+
+    # --- 1. elementwise: drift + wrap + bin -> dest key -----------------
+    full_shape = tuple(d * v for d, v in zip(dev_grid.shape, vgrid.shape))
+    full_grid = ProcessGrid(full_shape)
+
+    def bin_one(f, v_id):
+        cell = binning.cell_of_position(
+            binning.wrap_periodic(f[:, :3], domain), domain, full_grid
+        )
+        vshape = jnp.asarray(vgrid.shape, jnp.int32)
+        dest_v = binning.rank_of_cell(cell % vshape, vgrid)
+        staying = dest_v == v_id
+        alive = f[:, -1] > 0.5
+        return jnp.where(
+            alive & ~staying, dest_v, R_TOTAL
+        ).astype(jnp.int32)
+
+    def make_bin_loop(S):
+        @jax.jit
+        def loop(fused):
+            def body(f, _):
+                p = f[..., :3] + f[..., 3:6] * jnp.float32(1e-4)
+                p = binning.wrap_periodic(p, domain)
+                f = jnp.concatenate([p, f[..., 3:]], axis=-1)
+                key = jax.vmap(bin_one)(f, jnp.arange(V, dtype=jnp.int32))
+                # dependency: fold key stats back into carry
+                f = f.at[:, 0, 0].add(key.sum(axis=1).astype(jnp.float32) * 0)
+                return f, ()
+
+            f, _ = lax.scan(body, fused, None, length=S)
+            return f
+
+        return loop
+
+    timed("drift+wrap+bin (elementwise)", make_bin_loop, fused)
+
+    # --- 2. stable key sort + counts ------------------------------------
+    def make_sort_loop(S):
+        @jax.jit
+        def loop(key):
+            def body(k, _):
+                order, counts, bounds = jax.vmap(
+                    lambda kk: binning.sorted_dest_counts(kk, R_TOTAL)
+                )(k)
+                k = (k + order[:, :1] * 0 + counts[:, :1] * 0).astype(
+                    jnp.int32
+                )
+                return k, ()
+
+            k, _ = lax.scan(body, key, None, length=S)
+            return k
+
+        return loop
+
+    timed("stable sort + searchsorted", make_sort_loop, dest_key)
+
+    # --- 3. pack gather: [V, R*C] rows from [V, n, K] --------------------
+    def make_gather_loop(S):
+        @jax.jit
+        def loop(fused, idx):
+            def body(carry, _):
+                f, i = carry
+                send = jax.vmap(
+                    lambda ff, ii: jnp.take(ff, ii, axis=0)
+                )(f, i)
+                i = (i + send[:, :1, 0].astype(jnp.int32) * 0) % n
+                return (f, i), ()
+
+            (f, i), _ = lax.scan(body, (fused, idx), None, length=S)
+            return f, i
+
+        return loop
+
+    timed(f"pack gather ({V}x{R_TOTAL*C} rows)", make_gather_loop, fused,
+          gather_idx)
+
+    # --- 4. landing scatter: [V, R*C] rows into [V, n, K] ----------------
+    def make_scatter_loop(S):
+        @jax.jit
+        def loop(fused, tgt, rows):
+            def body(carry, _):
+                f, t = carry
+                f = jax.vmap(
+                    lambda ff, tt, rr: ff.at[tt].set(rr, mode="drop")
+                )(f, t, rows)
+                t = (t + f[:, :1, 0].astype(jnp.int32) * 0) % n
+                return (f, t), ()
+
+            (f, t), _ = lax.scan(body, (fused, tgt), None, length=S)
+            return f, t
+
+        return loop
+
+    timed(f"landing scatter ({V}x{R_TOTAL*C} rows)", make_scatter_loop,
+          fused, target, rows)
+
+    # --- 5. exchange transposes ([V,Dev,V,C,K] round trip) ---------------
+    def make_transpose_loop(S):
+        @jax.jit
+        def loop(rows):
+            def body(r, _):
+                send = r.reshape(V, 1, V, C, K).transpose(1, 0, 2, 3, 4)
+                recv = send.transpose(2, 0, 1, 3, 4).reshape(
+                    V, V * C, K
+                )
+                r = recv.reshape(V, R_TOTAL * C, K) + r * 0
+                return r, ()
+
+            r, _ = lax.scan(body, rows, None, length=S)
+            return r
+
+        return loop
+
+    timed("exchange transposes (Dev=1)", make_transpose_loop, rows)
+
+    # --- 6. full migrate step (reference) --------------------------------
+    from mpi_grid_redistribute_tpu.parallel import migrate, mesh as mesh_lib
+    from mpi_grid_redistribute_tpu.models import nbody
+
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=1e-4, capacity=C, n_local=n
+    )
+    mesh = mesh_lib.make_mesh(dev_grid, devices=jax.devices()[:1])
+    pos = np.asarray(fused[0][:, :3]).copy()
+    pos_all = rng.random((V * n, 3), dtype=np.float32)
+    vel_all = rng.random((V * n, 3), dtype=np.float32) * 1e-4
+    alive_all = rng.random((V * n,)) < FILL
+    args = (
+        jax.device_put(jnp.asarray(pos_all)),
+        jax.device_put(jnp.asarray(vel_all)),
+        jax.device_put(jnp.asarray(alive_all)),
+    )
+    timed(
+        "FULL migrate step",
+        lambda S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid),
+        *args,
+    )
+
+    print("\n| stage | ms/step |\n|---|---|")
+    for name, ms in stages.items():
+        print(f"| {name} | {ms:.2f} |")
+    accounted = sum(v for k, v in stages.items() if "FULL" not in k)
+    print(f"| (sum of stages) | {accounted:.2f} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -189,6 +189,42 @@ def test_migrate_backlog_drains(rng, _devices):
     assert moved == 16  # the full backlog drained at 4/step
 
 
+def test_migrate_vranks_full_swap_is_lossless(rng, _devices):
+    """Two fully-occupied vranks exchanging every particle must complete
+    the swap (arrivals may land in same-step-vacated slots; the fixpoint
+    allocation seeds with self-financing pairwise swaps)."""
+    dev_grid = ProcessGrid((1, 1, 1))
+    vgrid = ProcessGrid((2, 1, 1))
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = 8
+    n = 2 * n_local
+    mesh = mesh_lib.make_mesh(dev_grid, devices=jax.devices()[:1])
+
+    # vrank 0 owns x in [0, .5), vrank 1 owns [.5, 1); place every row in
+    # the OTHER vrank's half-box, zero velocity, zero free slots.
+    pos = rng.random((n, 3), dtype=np.float32)
+    pos[:n_local, 0] = 0.75
+    pos[n_local:, 0] = 0.25
+    vel = np.zeros((n, 3), dtype=np.float32)
+    alive = np.ones(n, dtype=bool)
+
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=0.0, capacity=n_local,
+        n_local=n_local,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, 1, vgrid=vgrid)
+    pos_f, vel_f, alive_f, stats = jax.tree.map(
+        np.asarray, loop(pos, vel, alive)
+    )
+    assert stats.dropped_recv.sum() == 0
+    assert stats.backlog.sum() == 0
+    assert stats.sent.sum() == n
+    assert alive_f.sum() == n
+    # every row now sits on its owning vrank slab
+    assert (pos_f[:n_local, 0] < 0.5).all()
+    assert (pos_f[n_local:, 0] >= 0.5).all()
+
+
 def _slab_full_ranks(dev_grid, vgrid):
     """full-grid rank of each (device, vrank) slab, device-major order."""
     full = ProcessGrid(
