@@ -104,14 +104,14 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     from mpi_grid_redistribute_tpu.utils import profiling
 
     t0 = time.perf_counter()
-    per_step, _overhead = profiling.scan_time_per_step(
+    per_step, _overhead, long_out = profiling.scan_time_per_step(
         lambda S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid),
         (pos, vel, alive),
         s1=s1,
         s2=s2,
     )
     c1 = time.perf_counter() - t0  # includes both compiles
-    stats = profiling.scan_time_per_step.last_output[3]
+    stats = long_out[3]
     sent = np.asarray(stats.sent).sum(axis=1)
     backlog = np.asarray(stats.backlog).sum()
     dropped = np.asarray(stats.dropped_recv).sum()
@@ -192,9 +192,13 @@ def main() -> None:
         f"8-rank CPU baseline (reference-equivalent numpy): "
         f"{cpu_pps:.3e} particles/s"
     )
+    from mpi_grid_redistribute_tpu.utils import native
+
+    native.build()  # explicit opt-in; falls back to NumPy with a log line
     cpu_native_pps = time_cpu_oracle(baseline_n, migration, native_ok=True)
     _stderr(
-        f"8-rank CPU with our C++ host runtime: "
+        f"8-rank CPU with our C++ host runtime"
+        f"{'' if native.available() else ' (FALLBACK: numpy)'}: "
         f"{cpu_native_pps:.3e} particles/s"
     )
 

@@ -50,7 +50,7 @@ def run(n_local: int = None, migration: float = 0.02) -> dict:
         jax.device_put(jnp.asarray(vel)),
         jax.device_put(jnp.asarray(alive)),
     )
-    per_step, _ = profiling.scan_time_per_step(
+    per_step, _, _out = profiling.scan_time_per_step(
         lambda S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid),
         (pos, vel, alive),
         s1=4,

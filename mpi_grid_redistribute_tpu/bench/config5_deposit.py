@@ -61,7 +61,7 @@ def run(n_local: int = None, mesh_cells: int = 128) -> dict:
     )
     count = np.full((R,), n_local, dtype=np.int32)
 
-    per_step, _ = profiling.scan_time_per_step(
+    per_step, _, _out = profiling.scan_time_per_step(
         lambda S: nbody.make_drift_loop(cfg, mesh, S, deposit_each_step=True),
         (pos, vel, count),
         s1=4,
@@ -74,6 +74,7 @@ def run(n_local: int = None, mesh_cells: int = 128) -> dict:
         "n_total": n,
         "chips": n_chips,
         "deposit_mesh": list(dshape),
+        "deposit_method": cfg.deposit_method,
         "ms_per_step": round(per_step * 1e3, 2),
     }
     common.log(f"config5: {per_step*1e3:.2f} ms/step incl. CIC {dshape}")

@@ -546,8 +546,6 @@ def shard_migrate_vranks_fn(
             recv_counts_rem = recv_counts_rem.transpose(2, 0, 1).reshape(
                 V, Dev * V
             )
-        else:
-            sent_remote = jnp.zeros((V,), jnp.int32)
 
         n_sent = sent_local + sent_remote
 

@@ -30,20 +30,29 @@ def save(
     nranks: int,
     step: int = 0,
     extra: Optional[dict] = None,
+    per_shard: Sequence[str] = ("count",),
 ) -> None:
     """Write one npz per shard + a manifest.
 
     ``arrays`` maps names to global padded arrays whose leading dim divides
-    by ``nranks`` (the library's global layout) — or to [nranks]-shaped
-    per-shard scalars (e.g. ``count``), stored in the manifest shard files
-    as-is.
+    by ``nranks`` (the library's global layout). Names listed in
+    ``per_shard`` are instead treated as [nranks]-shaped per-shard scalar
+    vectors (one entry per shard, e.g. the ``count`` array); membership is
+    by name, never inferred from shape, so a genuine global 1-D array that
+    happens to have ``nranks`` rows shards normally.
     """
     os.makedirs(directory, exist_ok=True)
+    per_shard = tuple(per_shard)
     rows = None
     for name, a in arrays.items():
         a = np.asarray(a)
-        if a.shape[0] == nranks and a.ndim == 1:
-            continue  # per-shard scalar vector
+        if name in per_shard:
+            if a.shape != (nranks,):
+                raise ValueError(
+                    f"per-shard array {name!r} must have shape "
+                    f"({nranks},), got {a.shape}"
+                )
+            continue
         if a.shape[0] % nranks:
             raise ValueError(
                 f"array {name!r} leading dim {a.shape[0]} does not divide "
@@ -62,7 +71,7 @@ def save(
         shard = {}
         for name, a in arrays.items():
             a = np.asarray(a)
-            if a.shape[0] == nranks and a.ndim == 1:
+            if name in per_shard:
                 shard[name] = a[rank : rank + 1]
             else:
                 shard[name] = a[rank * rows : (rank + 1) * rows]
@@ -74,6 +83,7 @@ def save(
         "rows_per_shard": rows,
         "step": step,
         "names": sorted(arrays.keys()),
+        "per_shard": sorted(n for n in per_shard if n in arrays),
         "extra": extra or {},
     }
     with open(os.path.join(directory, _MANIFEST), "w") as f:

@@ -6,14 +6,18 @@ equivalent — digitize / counting-sort pack / row gather — for the CPU
 oracle and host-side tooling. pybind11 is not in this image, so the C ABI
 + ctypes is the binding (no build-time Python deps).
 
-The library auto-builds with g++ on first use when the .so is missing;
-every entry point has a NumPy fallback so the package works without a
-toolchain (``available()`` reports which path is live).
+Building the .so is opt-in: call :func:`build` explicitly (bench drivers
+and tests do), or set ``MPI_GRID_NATIVE_BUILD=1`` to allow a g++ build on
+first use. Every entry point has a NumPy fallback so the package works
+without a toolchain; the first silent fallback on a native-requested call
+is logged so users know which path produced their numbers (``available()``
+reports which path is live).
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
@@ -25,6 +29,8 @@ _LIB_NAME = "libgrid_redistribute_native.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_logged_fallback = False
+_log = logging.getLogger(__name__)
 
 
 def _native_dir() -> str:
@@ -32,6 +38,44 @@ def _native_dir() -> str:
         os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
         "native",
     )
+
+
+def build(timeout: float = 120) -> bool:
+    """Build the C++ library (native/build.sh, g++) if not already loaded.
+
+    Explicit opt-in for the compiler invocation; returns True when the
+    library is usable afterwards, False (with a log line) otherwise.
+    """
+    global _tried
+    if os.environ.get("MPI_GRID_NO_NATIVE"):
+        return False  # user opted out: never compile
+    if _load() is not None:
+        return True
+    script = os.path.join(_native_dir(), "build.sh")
+    if not os.path.exists(script):
+        _log.warning("native build script missing: %s", script)
+        return False
+    try:
+        subprocess.run(
+            [script], check=True, capture_output=True, timeout=timeout
+        )
+    except (subprocess.SubprocessError, OSError) as e:
+        _log.warning("native build failed (%s); using NumPy fallback", e)
+        return False
+    with _lock:
+        _tried = False  # retry the load now that the .so exists
+    return _load() is not None
+
+
+def _note_fallback() -> None:
+    """Log once when a native-requested call falls back to NumPy."""
+    global _logged_fallback
+    if not _logged_fallback:
+        _logged_fallback = True
+        _log.warning(
+            "C++ host runtime unavailable (call utils.native.build() or "
+            "set MPI_GRID_NATIVE_BUILD=1); using NumPy fallback"
+        )
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -43,12 +87,15 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("MPI_GRID_NO_NATIVE"):
             return None
         path = os.path.join(_native_dir(), _LIB_NAME)
-        if not os.path.exists(path):
-            build = os.path.join(_native_dir(), "build.sh")
-            if os.path.exists(build):
+        if not os.path.exists(path) and os.environ.get(
+            "MPI_GRID_NATIVE_BUILD"
+        ):
+            build_script = os.path.join(_native_dir(), "build.sh")
+            if os.path.exists(build_script):
                 try:
                     subprocess.run(
-                        [build], check=True, capture_output=True, timeout=120
+                        [build_script], check=True, capture_output=True,
+                        timeout=120,
                     )
                 except (subprocess.SubprocessError, OSError):
                     return None
@@ -101,6 +148,7 @@ def bin_positions(pos: np.ndarray, domain, grid) -> np.ndarray:
     """Destination rank per row — C++ twin of binning.rank_of_position."""
     lib = _load()
     if lib is None:
+        _note_fallback()
         from mpi_grid_redistribute_tpu.ops import binning
 
         return binning.rank_of_position(pos, domain, grid, xp=np)
@@ -134,6 +182,7 @@ def count_sort(dest: np.ndarray, nranks: int) -> Tuple[np.ndarray, np.ndarray]:
     lib = _load()
     dest = np.ascontiguousarray(dest, dtype=np.int32)
     if lib is None:
+        _note_fallback()
         counts = np.bincount(
             dest, minlength=nranks + 1
         )[:nranks].astype(np.int64)
@@ -155,6 +204,7 @@ def gather_rows(src: np.ndarray, order: np.ndarray) -> np.ndarray:
     """out[j] = src[order[j]] — the pack gather, one memcpy pass in C++."""
     lib = _load()
     if lib is None:
+        _note_fallback()
         return src[order]
     src = np.ascontiguousarray(src)
     order = np.ascontiguousarray(order, dtype=np.int64)

@@ -39,22 +39,22 @@ def scan_time_per_step(
     s1: int = 8,
     s2: int = 72,
     reps: int = 2,
-) -> Tuple[float, float]:
+) -> Tuple[float, float, object]:
     """Per-step seconds of ``make_loop(S)(*args)`` via length differencing.
 
     ``make_loop(S)`` must return a jitted callable running S steps (e.g.
     ``lambda S: nbody.make_migrate_loop(cfg, mesh, S)``). Returns
-    ``(per_step_seconds, fixed_overhead_seconds)``; the latter is the
-    per-invocation cost the differencing removed (useful to sanity-check
-    the method: it should dwarf neither measurement). The long loop's
-    output pytree is kept on ``scan_time_per_step.last_output`` so callers
-    can inspect stats without paying another invocation.
+    ``(per_step_seconds, fixed_overhead_seconds, long_loop_output)``;
+    the overhead is the per-invocation cost the differencing removed
+    (useful to sanity-check the method: it should dwarf neither
+    measurement), and the long loop's output pytree lets callers inspect
+    stats without paying another invocation.
     """
     if s2 <= s1:
         raise ValueError(f"need s2 > s1 for differencing, got {s1} >= {s2}")
     loops = {s: make_loop(s) for s in (s1, s2)}
 
-    def run(s: int) -> float:
+    def run(s: int):
         out = loops[s](*args)
         fetch_barrier(out)  # warm: compile + first run
         best = float("inf")
@@ -63,12 +63,12 @@ def scan_time_per_step(
             out = loops[s](*args)
             fetch_barrier(out)
             best = min(best, time.perf_counter() - t0)
-        scan_time_per_step.last_output = out
-        return best
+        return best, out
 
-    t1, t2 = run(s1), run(s2)
+    t1, _ = run(s1)
+    t2, out2 = run(s2)
     per_step = (t2 - t1) / (s2 - s1)
-    return per_step, t1 - per_step * s1
+    return per_step, t1 - per_step * s1, out2
 
 
 @contextlib.contextmanager

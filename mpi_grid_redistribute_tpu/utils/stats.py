@@ -55,12 +55,16 @@ def summarize_migrate(stats) -> Dict[str, float]:
 
 
 def check_no_loss(stats) -> None:
-    """Raise if any surfaced overflow counter is nonzero."""
+    """Raise if any surfaced *loss* counter is nonzero.
+
+    ``backlog`` is intentionally not treated as loss: backlogged migrants
+    stay resident and retry next step (retry-not-loss by design).
+    """
     problems = []
-    for name in ("dropped_send", "dropped_recv", "backlog"):
+    for name in ("dropped_send", "dropped_recv"):
         if hasattr(stats, name):
             v = int(np.asarray(getattr(stats, name)).sum())
-            if v and name != "backlog":
+            if v:
                 problems.append(f"{name}={v}")
     if problems:
         raise RuntimeError(

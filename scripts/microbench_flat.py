@@ -10,7 +10,7 @@ V, N, K = 8, 2**20, 7
 
 
 def timed(name, make_loop, *args, s1=4, s2=24):
-    per_step, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    per_step, _, _out = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
     print(f"  {name:44s} {per_step*1e3:8.3f} ms", file=sys.stderr)
 
 

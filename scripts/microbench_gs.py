@@ -26,7 +26,7 @@ N = 2**20  # rows in the resident array
 
 
 def timed(name, make_loop, *args, s1=4, s2=24):
-    per_step, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    per_step, _, _out = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
     print(f"  {name:44s} {per_step*1e3:8.3f} ms", file=sys.stderr)
     return per_step * 1e3
 

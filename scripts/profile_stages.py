@@ -75,7 +75,7 @@ def main():
     stages = {}
 
     def timed(name, make_loop, *args, s1=4, s2=24):
-        per_step, _ = profiling.scan_time_per_step(
+        per_step, _, _out = profiling.scan_time_per_step(
             make_loop, args, s1=s1, s2=s2
         )
         stages[name] = per_step * 1e3

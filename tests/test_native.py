@@ -10,7 +10,8 @@ from mpi_grid_redistribute_tpu.utils import native
 
 
 pytestmark = pytest.mark.skipif(
-    not native.available(), reason="native library not built (no g++?)"
+    not native.build(),  # explicit opt-in build (advisor: no implicit g++)
+    reason="native library not built (no g++?)",
 )
 
 

@@ -33,6 +33,30 @@ def test_checkpoint_partial_ranks(tmp_path, rng):
     )
 
 
+def test_checkpoint_per_shard_is_by_name_not_shape(tmp_path, rng):
+    # A genuine global 1-D array with exactly nranks rows (n_local=1) must
+    # shard normally; only names listed in per_shard are per-shard scalars.
+    R = 4
+    arrays = {
+        "pos": rng.random((R, 3)).astype(np.float32),  # n_local = 1
+        "ids": np.arange(R, dtype=np.int64),  # global, happens to be [R]
+        "count": np.ones((R,), dtype=np.int32),
+    }
+    checkpoint.save(str(tmp_path / "ck"), arrays, R)
+    back, manifest = checkpoint.load(str(tmp_path / "ck"))
+    assert manifest["per_shard"] == ["count"]
+    assert manifest["rows_per_shard"] == 1
+    for k in arrays:
+        np.testing.assert_array_equal(back[k], arrays[k])
+    # wrong-shaped per-shard array is an error, not silently sharded
+    with pytest.raises(ValueError, match="per-shard"):
+        checkpoint.save(
+            str(tmp_path / "ck2"),
+            {"pos": arrays["pos"], "count": np.ones((R, 2), np.int32)},
+            R,
+        )
+
+
 def test_checkpoint_rejects_ragged(tmp_path, rng):
     with pytest.raises(ValueError, match="divide"):
         checkpoint.save(
@@ -93,11 +117,12 @@ def test_scan_time_per_step_smoke(_devices):
             return out
         return loop
 
-    per, overhead = profiling.scan_time_per_step(
+    per, overhead, out = profiling.scan_time_per_step(
         make_loop, (jnp.ones((1024,)),), s1=2, s2=16, reps=1
     )
     assert per >= 0.0 or abs(per) < 1e-3  # tiny op: just don't blow up
     assert np.isfinite(overhead)
+    assert out.shape == (1024,)  # long loop's output is returned
 
 
 def test_exchange_bytes_per_step():
