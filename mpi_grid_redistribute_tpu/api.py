@@ -40,6 +40,11 @@ class RedistributeResult(NamedTuple):
     stats: object
 
 
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
 def _as_domain(domain, lo=None, hi=None, periodic=False) -> Domain:
     if isinstance(domain, Domain):
         return domain
@@ -64,6 +69,21 @@ class GridRedistribute:
         (SURVEY.md §7.6 load-imbalance tension; raise for clustered data).
       out_capacity: padded rows per shard on output; default ``n_local``
         (same layout as input, so drift loops iterate with static shapes).
+      on_overflow: what to do when a capacity overflow drops particles
+        (SURVEY.md §7.6 "measured capacity + recompile-on-growth", §5.3):
+
+        * ``'grow'`` (default) — read the measured overflow off the stats,
+          rebuild at the next power-of-two capacity bucket, and re-run the
+          same step on the unchanged inputs; the grown capacities stick on
+          the instance, so later calls recompile only on further bucket
+          crossings. Loss-free, but syncs stats to the host every call.
+        * ``'raise'`` — raise :class:`RuntimeError` on any drop (also a
+          host sync). The opt-out of growth that still never loses
+          silently.
+        * ``'ignore'`` — return with drop counters surfaced in
+          ``result.stats`` (the round-1 behavior). The only mode that
+          keeps dispatch fully asynchronous; callers own the check, e.g.
+          ``utils.stats.check_no_loss``.
     """
 
     def __init__(
@@ -79,6 +99,7 @@ class GridRedistribute:
         capacity: Optional[int] = None,
         capacity_factor: float = 2.0,
         out_capacity: Optional[int] = None,
+        on_overflow: str = "grow",
     ):
         self.domain = _as_domain(domain, lo, hi, periodic)
         if grid is None:
@@ -93,6 +114,12 @@ class GridRedistribute:
         for name, v in (("capacity", capacity), ("out_capacity", out_capacity)):
             if v is not None and int(v) < 1:
                 raise ValueError(f"{name} must be >= 1, got {v}")
+        if on_overflow not in ("grow", "raise", "ignore"):
+            raise ValueError(
+                f"on_overflow must be 'grow', 'raise' or 'ignore', "
+                f"got {on_overflow!r}"
+            )
+        self.on_overflow = on_overflow
         self.capacity = capacity
         self.capacity_factor = float(capacity_factor)
         self.out_capacity = out_capacity
@@ -118,7 +145,7 @@ class GridRedistribute:
             # or growing workloads then re-trigger compilation only on
             # bucket crossings, not on every new (n_local, capacity) pair
             # (SURVEY.md §7.6 "measured capacity + recompile-on-growth").
-            cap = 1 << (cap - 1).bit_length()
+            cap = _next_pow2(cap)
         cap = min(cap, n_local)  # can never send more than n_local to one dest
         out_cap = n_local if self.out_capacity is None else self.out_capacity
         return cap, out_cap
@@ -176,16 +203,9 @@ class GridRedistribute:
             )
         return pos, fields, n_local, count
 
-    def redistribute(self, positions, *fields, count=None) -> RedistributeResult:
-        """Bin, pack, exchange: every particle moves to its owner shard.
-
-        Returns a :class:`RedistributeResult` in the same global padded
-        layout (leading dim ``R * out_capacity``).
-        """
-        positions, fields, n_local, count = self._check_inputs(
-            positions, fields, count
-        )
-        cap, out_cap = self._capacities(n_local)
+    def _run_once(
+        self, positions, fields, count, cap: int, out_cap: int
+    ) -> RedistributeResult:
         if self.backend == "numpy":
             pos_out, counts_out, fields_out, stats = (
                 oracle.redistribute_oracle_padded(
@@ -208,10 +228,66 @@ class GridRedistribute:
             self.mesh, self.domain, self.grid, cap, out_cap, len(fields)
         )
         out = fn(positions, count, *fields)
-        pos_out, count_out = out[0], out[1]
-        fields_out = tuple(out[2:-1])
-        stats = out[-1]
-        return RedistributeResult(pos_out, fields_out, count_out, stats)
+        return RedistributeResult(
+            out[0], tuple(out[2:-1]), out[1], out[-1]
+        )
+
+    def redistribute(self, positions, *fields, count=None) -> RedistributeResult:
+        """Bin, pack, exchange: every particle moves to its owner shard.
+
+        Returns a :class:`RedistributeResult` in the same global padded
+        layout (leading dim ``R * out_capacity``). Under the default
+        ``on_overflow='grow'`` a capacity overflow is healed by measuring
+        the need from the stats, rebuilding at the next power-of-two
+        bucket, and re-running on the unchanged inputs — no particle is
+        ever lost and steady workloads recompile only on bucket crossings.
+        """
+        positions, fields, n_local, count = self._check_inputs(
+            positions, fields, count
+        )
+        max_attempts = 5
+        for _ in range(max_attempts):
+            cap, out_cap = self._capacities(n_local)
+            result = self._run_once(positions, fields, count, cap, out_cap)
+            if self.on_overflow == "ignore":
+                return result  # async preserved: no host sync on stats
+            dropped_send = int(np.asarray(result.stats.dropped_send).sum())
+            dropped_recv = int(np.asarray(result.stats.dropped_recv).sum())
+            if not dropped_send and not dropped_recv:
+                return result
+            if self.on_overflow == "raise":
+                raise RuntimeError(
+                    f"particle loss detected: dropped_send={dropped_send}, "
+                    f"dropped_recv={dropped_recv} — raise capacity / "
+                    f"out_capacity or use on_overflow='grow'"
+                )
+            # grow: size the rebuild from the measured need, bucketed to
+            # powers of two so recompiles track bucket crossings only
+            grew = False
+            if dropped_send:
+                needed = int(np.asarray(result.stats.needed_capacity).max())
+                new_cap = min(_next_pow2(needed), n_local)
+                if new_cap > cap:
+                    self.capacity, grew = new_cap, True
+            if dropped_recv:
+                needed_out = int(
+                    (
+                        np.asarray(result.count)
+                        + np.asarray(result.stats.dropped_recv)
+                    ).max()
+                )
+                new_out = min(_next_pow2(needed_out), self.nranks * n_local)
+                if new_out > out_cap:
+                    self.out_capacity, grew = new_out, True
+            if not grew:
+                raise RuntimeError(
+                    f"overflow not resolvable by growth (capacity {cap}, "
+                    f"out_capacity {out_cap} already at their maxima): "
+                    f"dropped_send={dropped_send} dropped_recv={dropped_recv}"
+                )
+        raise RuntimeError(
+            f"capacity growth did not converge in {max_attempts} attempts"
+        )
 
     __call__ = redistribute
 

@@ -79,7 +79,9 @@ def make_drift_step(cfg: DriftConfig, mesh: Mesh):
         spec,
         spec,
         spec,
-        exchange.RedistributeStats(spec, spec, spec, spec),
+        exchange.RedistributeStats(
+            *([spec] * len(exchange.RedistributeStats._fields))
+        ),
     )
     if dep_fn is not None:
         out_specs = out_specs + (P(*axes),)

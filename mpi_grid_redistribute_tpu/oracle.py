@@ -125,6 +125,7 @@ def redistribute_oracle_padded(
 
     send_counts = np.zeros((R, R), dtype=np.int32)
     dropped_send = np.zeros((R,), dtype=np.int32)
+    needed_capacity = np.zeros((R,), dtype=np.int32)
     send_rows: List[List[np.ndarray]] = []
     for s in range(R):
         sl = slice(s * n_local, s * n_local + int(counts[s]))
@@ -143,6 +144,9 @@ def redistribute_oracle_padded(
             dcounts = np.bincount(dest, minlength=R + 1)[:R]
             order = np.argsort(dest, kind="stable")
         bounds = np.concatenate([[0], np.cumsum(dcounts)])
+        remote = np.asarray(dcounts[:R]).copy()
+        remote[s] = 0
+        needed_capacity[s] = remote.max() if R > 1 else 0
         rows = []
         for d in range(R):
             idx = order[bounds[d] : bounds[d + 1]] + s * n_local
@@ -179,6 +183,7 @@ def redistribute_oracle_padded(
         "recv_counts": send_counts.T.copy(),
         "dropped_send": dropped_send,
         "dropped_recv": dropped_recv,
+        "needed_capacity": needed_capacity,
     }
     return pos_out, counts_out, fields_out, stats
 

@@ -34,12 +34,18 @@ class RedistributeStats(NamedTuple):
     """Per-step observability (SURVEY.md §5.5). Global (post-shard_map)
     shapes: ``send_counts`` is [R, R] indexed [source, dest];
     ``recv_counts`` is its transpose, [dest, source] (row r = what rank r
-    received from each source); drop counters are [R]."""
+    received from each source); drop counters are [R].
+
+    ``needed_capacity`` is the *measured* per-rank max unclipped remote
+    per-destination count — the smallest per-pair ``capacity`` that would
+    have sent everything (SURVEY.md §7.6 "measured capacity"); the
+    adaptive-growth loop in :mod:`..api` sizes its rebuild from it."""
 
     send_counts: jax.Array
     recv_counts: jax.Array
     dropped_send: jax.Array
     dropped_recv: jax.Array
+    needed_capacity: jax.Array
 
 
 def shard_redistribute_fn(
@@ -97,6 +103,9 @@ def shard_redistribute_fn(
             recv_counts=(recv_counts + self_onehot)[None, :],
             dropped_send=dropped_send[None].astype(jnp.int32),
             dropped_recv=dropped_recv[None],
+            # remote_counts[me] is 0 (self rows carry the sentinel), so the
+            # max is over genuine remote pairs.
+            needed_capacity=jnp.max(remote_counts)[None].astype(jnp.int32),
         )
         return (out[0], new_count[None]) + tuple(out[1:]) + (stats,)
 
@@ -126,7 +135,7 @@ def build_redistribute(
     out_specs = (
         (spec, spec)
         + (spec,) * n_fields
-        + (RedistributeStats(spec, spec, spec, spec),)
+        + (RedistributeStats(*([spec] * len(RedistributeStats._fields))),)
     )
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return jax.jit(sharded)

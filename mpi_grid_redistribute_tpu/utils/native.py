@@ -70,6 +70,8 @@ def build(timeout: float = 120) -> bool:
 def _note_fallback() -> None:
     """Log once when a native-requested call falls back to NumPy."""
     global _logged_fallback
+    if os.environ.get("MPI_GRID_NO_NATIVE"):
+        return  # deliberate opt-out: fallback is the requested behavior
     if not _logged_fallback:
         _logged_fallback = True
         _log.warning(

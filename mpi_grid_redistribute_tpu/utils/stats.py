@@ -34,6 +34,9 @@ def summarize_redistribute(stats) -> Dict[str, float]:
         "recv_imbalance": _imbalance(recv2.sum(axis=2).mean(axis=0)),
         "dropped_send": int(np.asarray(stats.dropped_send).sum()),
         "dropped_recv": int(np.asarray(stats.dropped_recv).sum()),
+        # measured per-pair need: the smallest per-pair capacity that
+        # would have sent everything (feeds adaptive growth, api.py)
+        "needed_capacity": int(np.asarray(stats.needed_capacity).max()),
     }
 
 

@@ -98,6 +98,7 @@ def test_summarize_redistribute():
         recv_counts=np.transpose(send, (0, 2, 1)),
         dropped_send=np.zeros((R,), np.int32),
         dropped_recv=np.zeros((R,), np.int32),
+        needed_capacity=np.full((R,), 5, np.int32),
     )
     s = stats.summarize_redistribute(st)
     assert s["moved_rows"] == 5.0
