@@ -39,7 +39,7 @@ class DriftConfig:
     capacity: int
     n_local: int  # padded rows per shard; also the out_capacity
     deposit_shape: Optional[Tuple[int, ...]] = None  # global CIC mesh cells
-    deposit_method: str = "segment"  # "segment" (exact f32) | "scan" (fast)
+    deposit_method: str = "scan"  # "scan" (fast, double-float exact) | "segment"
     # on-device migrant budget per (vrank, step) for the vrank migrate
     # path's compact routing (None -> V * capacity); see
     # parallel.migrate.shard_migrate_vranks_fn
@@ -84,7 +84,7 @@ def make_drift_step(cfg: DriftConfig, mesh: Mesh):
         ),
     )
     if dep_fn is not None:
-        out_specs = out_specs + (P(*axes),)
+        out_specs = out_specs + (deposit_lib.deposit_out_spec(cfg.domain, cfg.grid),)
     return jax.jit(
         shard_map(
             shard_step, mesh=mesh, in_specs=(spec, spec, spec),
@@ -132,7 +132,14 @@ def make_drift_loop(
 
         init = (pos, vel, count)
         if deposit_each_step:
-            init = init + (jnp.zeros(cfg.deposit_shape, jnp.float32),)
+            init = init + (
+                jnp.zeros(
+                    deposit_lib.global_node_shape(
+                        cfg.domain, cfg.deposit_shape
+                    ),
+                    jnp.float32,
+                ),
+            )
         carry, stats = lax.scan(body, init, None, length=n_steps)
         pos_f, vel_f, count_f = carry[:3]
         if deposit_each_step:
@@ -179,7 +186,7 @@ def make_migrate_step(cfg: DriftConfig, mesh: Mesh):
     stats_spec = migrate.MigrateStats(*([spec] * len(migrate.MigrateStats._fields)))
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:
-        out_specs = out_specs + (P(*axes),)
+        out_specs = out_specs + (deposit_lib.deposit_out_spec(cfg.domain, cfg.grid),)
     return jax.jit(
         shard_map(
             shard_step, mesh=mesh, in_specs=(spec, spec, spec),
@@ -287,7 +294,7 @@ def make_migrate_loop(
     )
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:
-        out_specs = out_specs + (P(*axes),)
+        out_specs = out_specs + (deposit_lib.deposit_out_spec(cfg.domain, cfg.grid),)
     return jax.jit(
         shard_map(
             shard_loop, mesh=mesh, in_specs=(spec, spec, spec),
@@ -307,7 +314,8 @@ def build_deposit_masked(cfg: DriftConfig, mesh: Mesh):
     axes = cfg.grid.axis_names
     spec = P(axes)
     sharded = shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(*axes)
+        fn, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=deposit_lib.deposit_out_spec(cfg.domain, cfg.grid)
     )
     return jax.jit(sharded)
 

@@ -12,8 +12,10 @@ BASELINE.json configs[4]). TPU-native realization:
     each decomposed axis; after deposit the ghost faces are folded into the
     downstream neighbor with one ``lax.ppermute`` per axis (sequential
     folds handle edges/corners exactly);
-  * periodic domains only — the canonical N-body case; CIC node count per
-    axis equals the cell count, nodes wrap.
+  * periodic axes have as many nodes as cells (the upper face wraps onto
+    plane 0, sharded output); non-periodic axes carry one extra clamp-edge
+    node plane (``global_node_shape``), assembled dense + replicated via
+    :func:`assemble_dense`.
 
 Shapes are static throughout; the deposit fuses into the same jit as the
 redistribute for the config-5 pipeline.
@@ -41,17 +43,25 @@ def _check_mesh_shape(
         raise ValueError(
             f"mesh_shape must have {domain.ndim} axes, got {mesh_shape}"
         )
-    if not all(domain.periodic):
-        raise NotImplementedError(
-            "CIC deposit currently requires a fully periodic domain "
-            "(the reference's N-body use case); non-periodic node meshes "
-            "are ragged across ranks"
-        )
     for a, (m, g) in enumerate(zip(mesh_shape, grid.shape)):
         if m % g:
             raise ValueError(
                 f"axis {a}: mesh cells {m} not divisible by grid extent {g}"
             )
+
+
+def global_node_shape(
+    domain: Domain, mesh_shape: Tuple[int, ...]
+) -> Tuple[int, ...]:
+    """Global node-mesh shape for ``mesh_shape`` CELLS per axis.
+
+    Periodic axes have as many nodes as cells (the upper face wraps onto
+    plane 0); non-periodic axes carry one extra clamp-edge node plane at
+    the domain's upper boundary (fencepost), so boundary mass is kept, not
+    wrapped or dropped."""
+    return tuple(
+        m if p else m + 1 for m, p in zip(mesh_shape, domain.periodic)
+    )
 
 
 def _row_major_strides(shape: Tuple[int, ...]) -> jax.Array:
@@ -106,6 +116,53 @@ def cic_deposit_local(
     return total.reshape(ghost_shape)
 
 
+def _two_sum(a: jax.Array, b: jax.Array):
+    """Error-free float add (Knuth TwoSum): a + b == s + e exactly."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def _df_add(a_hi, a_lo, b_hi, b_lo):
+    """Double-float add: (a_hi + a_lo) + (b_hi + b_lo) as a (hi, lo) pair.
+
+    Error ~eps^2 of the result — the lo word carries what a single f32
+    rounds away."""
+    s, e = _two_sum(a_hi, b_hi)
+    e = e + (a_lo + b_lo)
+    hi = s + e
+    lo = e - (hi - s)
+    return hi, lo
+
+
+def _df_cumsum(x: jax.Array, axis: int, x_lo: jax.Array = None):
+    """Inclusive double-float prefix sum via log-depth doubling.
+
+    Hillis-Steele over a static-length axis: log2(n) shifted double-float
+    adds. Returns (hi, lo) with per-prefix error ~eps^2 of the prefix value
+    instead of plain cumsum's ~eps — the foundation of the scan deposit's
+    accuracy (differences of prefixes round at ulp(difference), not at
+    ulp(channel total)). ``x_lo`` carries input values already split into
+    (hi, lo) pairs (the tile-totals level)."""
+    n = x.shape[axis]
+    hi = x
+    lo = jnp.zeros_like(x) if x_lo is None else x_lo
+    shift = 1
+    while shift < n:
+        zeros_shape = list(x.shape)
+        zeros_shape[axis] = shift
+        z = jnp.zeros(zeros_shape, x.dtype)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, n - shift)
+        sl = tuple(sl)
+        hi_s = jnp.concatenate([z, hi[sl]], axis=axis)
+        lo_s = jnp.concatenate([z, lo[sl]], axis=axis)
+        hi, lo = _df_add(hi, lo, hi_s, lo_s)
+        shift *= 2
+    return hi, lo
+
+
 def cic_deposit_local_sorted(
     pos: jax.Array,
     mass: jax.Array,
@@ -113,6 +170,7 @@ def cic_deposit_local_sorted(
     lo_local: jax.Array,
     inv_h: jax.Array,
     local_shape: Tuple[int, ...],
+    tile: int = 256,
 ) -> jax.Array:
     """Scatter-free CIC deposit (same contract as :func:`cic_deposit_local`).
 
@@ -123,19 +181,24 @@ def cic_deposit_local_sorted(
       1. sort particles by **base** cell id (one ~6 ms key sort + one row
          gather);
       2. compute all 2^ndim corner weights as channels ``[N, 8]`` in sorted
-         order and take a per-channel prefix sum (cumsum is cheap on TPU);
+         order and take a per-channel **double-float tiled prefix sum**
+         (below);
       3. per-cell sums = differences of the prefix sum at run boundaries
          found by ``searchsorted`` over the sorted keys — pure gathers;
       4. place the 8 channel meshes onto the +1-ghost mesh with static
          offset pads (corner c's deposit lands at ``base + c``).
 
-    Accuracy note: per-cell values are differences of a length-N f32
-    prefix sum, so each is quantized at ~ulp(accumulated channel total) —
-    with unit masses at 4M particles that is ~0.06 absolute per cell.
-    Dense cells see small relative error, but a sparse cell late in the
-    sort order can be off by percent-level. Fine for density fields and
-    benchmarks; use :func:`cic_deposit_local` ("segment") when standard
-    f32 segment-sum accuracy matters.
+    Accuracy: a plain f32 cumsum quantizes every per-cell difference at
+    ~ulp(accumulated channel total) — percent-level for sparse cells at 4M
+    particles (the round-1 limitation). Here each prefix is carried as an
+    unevaluated (hi, lo) float pair (TwoSum arithmetic, error ~eps^2), in
+    two levels: an inclusive double-float cumsum within static ``tile``-row
+    tiles, plus a double-float scan over per-tile totals. Differencing the
+    paired prefixes at run boundaries rounds at ulp(the difference itself),
+    so per-cell error is ~ulp(cell value) + O(eps * frac rounding) —
+    *tighter* than the scatter-add path, which accumulates ~n_particles
+    sequential f32 roundings per cell. Tested to <=1e-5 relative against
+    a float64 oracle (tests/test_deposit.py).
     """
     ndim = pos.shape[1]
     n = pos.shape[0]
@@ -177,7 +240,20 @@ def cic_deposit_local_sorted(
         cols.append(mass_s * w)
     w8 = jnp.stack(cols, axis=1)
 
-    cw = jnp.cumsum(w8, axis=0)  # [N, 8] prefix sums
+    # --- double-float tiled prefix sums of the weight channels ---------
+    # Two levels keep the big-array work at log2(tile) doubling steps:
+    # within-tile inclusive prefixes on [T, K, 8], then a prefix over the
+    # [T, 8] tile totals (tiny). Both carry (hi, lo) pairs throughout.
+    nch = w8.shape[1]
+    K = max(1, min(tile, n))
+    n_pad = -(-n // K) * K
+    wt = jnp.pad(w8, ((0, n_pad - n), (0, 0))).reshape(n_pad // K, K, nch)
+    lhi, llo = _df_cumsum(wt, axis=1)  # within-tile inclusive prefixes
+    thi, tlo = _df_cumsum(lhi[:, -1], axis=0, x_lo=llo[:, -1])
+    z8 = jnp.zeros((1, nch), w8.dtype)
+    s_hi = jnp.concatenate([z8, thi], axis=0)  # exclusive tile prefixes
+    s_lo = jnp.concatenate([z8, tlo], axis=0)  # [T + 1, 8]
+
     # method="sort" lowers to one merge-style sort; the default "scan"
     # becomes a sequential while-loop (~80 ms at 262k queries, measured)
     bounds = jnp.searchsorted(
@@ -186,12 +262,24 @@ def cic_deposit_local_sorted(
         side="left",
         method="sort",
     ).astype(jnp.int32)
-    # inclusive-prefix difference: sum over the run [bounds[c], bounds[c+1])
-    zero_row = jnp.zeros((1, w8.shape[1]), w8.dtype)
-    cw_pad = jnp.concatenate([zero_row, cw], axis=0)
-    per_cell = jnp.take(cw_pad, bounds[1:], axis=0) - jnp.take(
-        cw_pad, bounds[:-1], axis=0
-    )  # [n_cells, 8]
+    # paired prefix G(b) = sum of first b sorted rows, evaluated only at
+    # the run boundaries: tile part + within-tile part (zero when b lands
+    # exactly on a tile edge).
+    t_idx = bounds // K
+    has_local = (bounds % K > 0)[:, None]
+    lhi_f = lhi.reshape(n_pad, nch)
+    llo_f = llo.reshape(n_pad, nch)
+    lb = jnp.clip(bounds - 1, 0, n_pad - 1)
+    g_hi, g_lo = _df_add(
+        jnp.take(s_hi, t_idx, axis=0),
+        jnp.take(s_lo, t_idx, axis=0),
+        jnp.where(has_local, jnp.take(lhi_f, lb, axis=0), 0.0),
+        jnp.where(has_local, jnp.take(llo_f, lb, axis=0), 0.0),
+    )
+    # run sum over [bounds[c], bounds[c+1]): the hi difference cancels the
+    # shared prefix exactly to ulp(difference); the lo difference restores
+    # what the hi words rounded away.
+    per_cell = (g_hi[1:] - g_hi[:-1]) + (g_lo[1:] - g_lo[:-1])  # [n_cells, 8]
 
     # place channel meshes at their corner offsets on the ghost mesh
     total = jnp.zeros(ghost_shape, dtype=mass.dtype)
@@ -201,6 +289,39 @@ def cic_deposit_local_sorted(
                                                  local_shape)]
         total = total + jnp.pad(block, pad)
     return total
+
+
+def assemble_dense(
+    rho_ghost: jax.Array, grid: ProcessGrid, domain: Domain
+) -> jax.Array:
+    """Assemble per-shard +1-ghost blocks into the full global node mesh.
+
+    The non-periodic alternative to :func:`fold_ghosts` (whose wrap would
+    misplace boundary mass): every shard writes its ghost block into a zero
+    global canvas of ``cells + 1`` node planes per axis at its own offset,
+    and one ``psum`` over the grid axes sums the overlapping ghost faces.
+    Periodic axes (mixed domains) then wrap their top plane onto plane 0.
+
+    Returns the canvas with :func:`global_node_shape` planes, *replicated*
+    across shards (each holds the full mesh — the memory trade for uniform
+    static shapes; node meshes are small next to particle state).
+    """
+    l = tuple(s - 1 for s in rho_ghost.shape)
+    canvas_shape = tuple(g * la + 1 for g, la in zip(grid.shape, l))
+    me = [lax.axis_index(n) for n in grid.axis_names]
+    start = tuple(m * la for m, la in zip(me, l))
+    canvas = jnp.zeros(canvas_shape, rho_ghost.dtype)
+    canvas = lax.dynamic_update_slice(canvas, rho_ghost, start)
+    canvas = lax.psum(canvas, grid.axis_names)
+    for a in range(len(l)):
+        if domain.periodic[a]:
+            m = canvas.shape[a] - 1
+            top = lax.slice_in_dim(canvas, m, m + 1, axis=a)
+            body = lax.slice_in_dim(canvas, 0, m, axis=a)
+            first = lax.slice_in_dim(body, 0, 1, axis=a) + top
+            rest = lax.slice_in_dim(body, 1, m, axis=a)
+            canvas = jnp.concatenate([first, rest], axis=a)
+    return canvas
 
 
 def fold_ghosts(
@@ -231,7 +352,7 @@ def fold_ghosts(
 
 def shard_deposit_fn_masked(
     domain: Domain, grid: ProcessGrid, mesh_shape: Tuple[int, ...],
-    method: str = "segment",
+    method: str = "scan",
 ):
     """Per-shard deposit closure taking an explicit validity mask.
 
@@ -239,10 +360,15 @@ def shard_deposit_fn_masked(
     rho_local[local_shape]``. Used by the resident-slot migration path
     (:mod:`..parallel.migrate`), whose live rows are a mask, not a prefix.
 
-    ``method``: ``"segment"`` (scatter-add ``segment_sum``; standard f32
-    accuracy) or ``"scan"`` (sort + prefix-sum + searchsorted, ~4x faster
-    on TPU at 4M particles, ~1e-4 relative accuracy — see
-    :func:`cic_deposit_local_sorted`).
+    ``method``: ``"scan"`` (sort + double-float prefix-sum + searchsorted,
+    several times faster than scatter-add on TPU and per-cell accurate —
+    see :func:`cic_deposit_local_sorted`) or ``"segment"`` (scatter-add
+    ``segment_sum``; standard f32 accuracy).
+
+    Fully periodic domains return this shard's ``local_shape`` block
+    (global mesh sharded over the grid axes); domains with any
+    non-periodic axis return the full :func:`global_node_shape` mesh
+    replicated on every shard (see :func:`assemble_dense`).
     """
     if method not in ("segment", "scan"):
         raise ValueError(f"method must be 'segment' or 'scan', got {method!r}")
@@ -270,14 +396,16 @@ def shard_deposit_fn_masked(
             ]
         )
         rho = deposit_impl(pos, mass, valid, lo_local, inv_h, local_shape)
-        return fold_ghosts(rho, grid)
+        if all(domain.periodic):
+            return fold_ghosts(rho, grid)
+        return assemble_dense(rho, grid, domain)
 
     return fn, local_shape
 
 
 def shard_deposit_fn(
     domain: Domain, grid: ProcessGrid, mesh_shape: Tuple[int, ...],
-    method: str = "segment",
+    method: str = "scan",
 ):
     """Per-shard deposit closure for use under ``shard_map``.
 
@@ -299,7 +427,7 @@ def shard_deposit_vranks_fn(
     dev_grid: ProcessGrid,
     vgrid: ProcessGrid,
     mesh_shape: Tuple[int, ...],
-    method: str = "segment",
+    method: str = "scan",
 ):
     """Per-device CIC deposit for virtual-rank state (``[V, n, K]`` slabs).
 
@@ -374,7 +502,9 @@ def shard_deposit_vranks_fn(
                 slice(c * b, c * b + b + 1) for c, b in zip(vc, vblock)
             )
             total = total.at[idx].add(rho_v[v])
-        return fold_ghosts(total, dev_grid)
+        if all(domain.periodic):
+            return fold_ghosts(total, dev_grid)
+        return assemble_dense(total, dev_grid, domain)
 
     return fn
 
@@ -388,28 +518,38 @@ def _pystrides(shape):
     return list(reversed(strides))
 
 
+def deposit_out_spec(domain: Domain, grid: ProcessGrid):
+    """``shard_map`` out_spec for the deposit's density mesh.
+
+    Fully periodic: rho axis a sharded over mesh axis a. Any non-periodic
+    axis: the dense-assembled mesh is replicated (see
+    :func:`assemble_dense`)."""
+    return P(*grid.axis_names) if all(domain.periodic) else P()
+
+
 def build_deposit(
     mesh: Mesh,
     domain: Domain,
     grid: ProcessGrid,
     mesh_shape: Tuple[int, ...],
-    method: str = "segment",
+    method: str = "scan",
 ):
     """jit-compiled global CIC deposit over ``mesh``.
 
     Global layout: ``pos`` [R*n_local, D] / ``mass`` [R*n_local] /
-    ``count`` [R], all sharded like the redistribute outputs; returns the
-    global density mesh ``[mesh_shape]`` sharded over the grid axes.
+    ``count`` [R], all sharded like the redistribute outputs. Fully
+    periodic domains return the global density mesh ``[mesh_shape]``
+    sharded over the grid axes; otherwise the ``global_node_shape`` mesh
+    (one extra clamp-edge plane per non-periodic axis), replicated.
     """
     fn, _ = shard_deposit_fn(domain, grid, mesh_shape, method=method)
     axes = grid.axis_names
     spec = P(axes)
-    out_spec = P(*axes)  # rho axis a sharded over mesh axis a
 
     sharded = shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=out_spec,
+        out_specs=deposit_out_spec(domain, grid),
     )
     return jax.jit(sharded)

@@ -83,11 +83,70 @@ def test_deposit_ghost_fold_across_faces(rng):
     np.testing.assert_allclose(rho.sum(), mass.sum(), rtol=1e-5)
 
 
-def test_deposit_rejects_nonperiodic():
-    with pytest.raises(NotImplementedError):
-        deposit_lib.shard_deposit_fn(
-            Domain(0.0, 1.0, periodic=False), GRID, MESH_SHAPE
-        )
+def cic_numpy_clamped(pos, mass, mesh_shape, domain):
+    """Global CIC oracle for non-periodic axes: cells+1 node planes, no
+    wrap, boundary particles clamp into the last cell (frac -> 1)."""
+    M = np.asarray(mesh_shape)
+    per = np.asarray(domain.periodic)
+    lo = np.asarray(domain.lo, dtype=np.float64)
+    ext = np.asarray(domain.extent, dtype=np.float64)
+    rel = (pos.astype(np.float64) - lo) / ext * M
+    i0 = np.clip(np.floor(rel).astype(np.int64), 0, M - 1)
+    frac = np.clip(rel - i0, 0.0, 1.0)
+    nodes = tuple(m if p else m + 1 for m, p in zip(mesh_shape, per))
+    rho = np.zeros(nodes, dtype=np.float64)
+    for corner in itertools.product((0, 1), repeat=3):
+        off = np.asarray(corner)
+        w = np.prod(np.where(off == 1, frac, 1.0 - frac), axis=1)
+        idx = np.where(per, (i0 + off) % M, i0 + off)
+        np.add.at(rho, (idx[:, 0], idx[:, 1], idx[:, 2]), mass * w)
+    return rho
+
+
+@pytest.mark.parametrize(
+    "periodic", [False, (True, False, True)], ids=["open", "mixed"]
+)
+def test_deposit_nonperiodic_matches_oracle(rng, periodic):
+    # round-1 verdict item 8: non-periodic CIC — one extra clamp-edge node
+    # plane per open axis, assembled dense + replicated; boundary mass at
+    # the upper faces lands on the last plane instead of wrapping.
+    dom = Domain(0.0, 1.0, periodic=periodic)
+    pos, mass = _deposit_inputs(rng, n_local=300)
+    pos[:40] = 0.999999  # exercise the upper boundary planes
+    pos[40:80, 0] = 0.0
+    rd = GridRedistribute(dom, GRID, capacity_factor=4.0, out_capacity=1200)
+    res = rd.redistribute(pos, mass)
+    mesh = mesh_lib.make_mesh(GRID)
+    dep = deposit_lib.build_deposit(mesh, dom, GRID, MESH_SHAPE)
+    rho = np.asarray(dep(res.positions, res.fields[0], res.count))
+    assert rho.shape == deposit_lib.global_node_shape(dom, MESH_SHAPE)
+    expected = cic_numpy_clamped(pos, mass, MESH_SHAPE, dom)
+    np.testing.assert_allclose(rho, expected, rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(rho.sum(), mass.sum(), rtol=1e-5)
+
+
+def test_deposit_nonperiodic_migrate_step(rng):
+    # the masked (migrate-path) deposit also supports open domains
+    from mpi_grid_redistribute_tpu.models import nbody
+    from mpi_grid_redistribute_tpu.ops import binning
+
+    dom = Domain(0.0, 1.0, periodic=False)
+    R = GRID.nranks
+    n_local = 64
+    cfg = nbody.DriftConfig(
+        domain=dom, grid=GRID, dt=0.0, capacity=16, n_local=n_local,
+        deposit_shape=(4, 4, 4),
+    )
+    mesh = mesh_lib.make_mesh(GRID)
+    step = nbody.make_migrate_step(cfg, mesh)
+    pos = rng.random((R * n_local, 3), dtype=np.float32)
+    dest = binning.rank_of_position(pos, dom, GRID, xp=np)
+    alive = dest == np.repeat(np.arange(R), n_local)
+    vel = np.zeros_like(pos)
+    out = jax.tree.map(np.asarray, step(pos, vel, alive))
+    rho = out[-1]
+    assert rho.shape == (5, 5, 5)
+    np.testing.assert_allclose(rho.sum(), alive.sum(), rtol=1e-5)
 
 
 def test_deposit_rejects_indivisible_mesh():
@@ -128,8 +187,8 @@ def test_masked_deposit_ignores_garbage_holes(rng, _devices):
 
 
 def test_scan_deposit_matches_segment(rng, _devices):
-    """The scatter-free 'scan' deposit agrees with segment_sum within the
-    documented prefix-sum tolerance, including NaN holes and ghost fold."""
+    """The scatter-free 'scan' deposit agrees with segment_sum tightly
+    (double-float prefixes), including NaN holes and ghost fold."""
     import jax
     import jax.numpy as jnp
     from mpi_grid_redistribute_tpu.ops import deposit as dep
@@ -156,7 +215,57 @@ def test_scan_deposit_matches_segment(rng, _devices):
     )
     assert np.isfinite(b).all()
     np.testing.assert_allclose(b.sum(), a.sum(), rtol=1e-5)
-    np.testing.assert_allclose(b, a, atol=a.max() * 1e-3)
+    np.testing.assert_allclose(b, a, atol=a.max() * 1e-6)
+
+
+def test_scan_deposit_accuracy_vs_float64_oracle(rng, _devices):
+    """Round-1 verdict item 5: the fast path's per-cell error vs a float64
+    oracle is <=1e-5 relative, at scale, on clustered data.
+
+    The f64 oracle sums the *same f32 per-particle weights* in float64, so
+    the comparison isolates summation error (the thing the double-float
+    prefix scheme fixes) from the shared f32 frac quantization. Strict
+    per-cell relative error is checked for every cell above 1e-6 of the
+    peak (below that, the ~eps^2 * channel-total double-float floor
+    dominates any fixed-precision prefix scheme)."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.ops import deposit as dep
+
+    N = 1_000_000
+    M = (16, 16, 16)
+    pos = (rng.lognormal(-1.5, 0.5, size=(N, 3)) % 1.0).astype(np.float32)
+    mass = rng.uniform(0.5, 2.0, N).astype(np.float32)
+    valid = rng.random(N) > 0.05
+    lo = jnp.zeros(3)
+    inv_h = jnp.full(3, float(M[0]))
+    got = np.asarray(
+        dep.cic_deposit_local_sorted(
+            jnp.asarray(pos), jnp.asarray(mass), jnp.asarray(valid), lo,
+            inv_h, M,
+        )
+    )
+    # float64 oracle over the f32 weight pipeline
+    posv, massv = pos[valid], mass[valid]
+    rel32 = posv * np.asarray(M, np.float32)
+    i0 = np.clip(np.floor(rel32).astype(np.int64), 0, np.asarray(M) - 1)
+    frac = np.clip(rel32 - i0.astype(np.float32), 0, 1).astype(np.float32)
+    rho = np.zeros(tuple(m + 1 for m in M))
+    for corner in itertools.product((0, 1), repeat=3):
+        off = np.asarray(corner)
+        w = np.prod(
+            np.where(off == 1, frac, np.float32(1) - frac), axis=1
+        ).astype(np.float32)
+        wf = (massv * w).astype(np.float32)
+        idx = i0 + off
+        np.add.at(rho, (idx[:, 0], idx[:, 1], idx[:, 2]), wf.astype(np.float64))
+
+    diff = np.abs(got - rho)
+    floor = rho.max() * 1e-6
+    cells = rho > floor
+    max_rel = (diff[cells] / rho[cells]).max()
+    assert max_rel <= 1e-5, f"max per-cell relative error {max_rel:.2e}"
+    assert diff.max() <= rho.max() * 1e-5  # normalized max error, all cells
+    np.testing.assert_allclose(got.sum(), rho.sum(), rtol=1e-6)
 
 
 def test_drift_loop_scan_deposit_method(rng, _devices):
