@@ -116,6 +116,14 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     backlog = np.asarray(stats.backlog).sum()
     dropped = np.asarray(stats.dropped_recv).sum()
     total = int(FILL * n_local) * R
+    # Exchange bandwidth (the second half of the BASELINE metric): bytes
+    # of migrant payload crossing the exchange per step. K fused f32
+    # columns per row (pos 3 + vel 3 + alive 1). On one chip the vrank
+    # exchange is HBM-side (routing gathers/scatters, no wire); with >=8
+    # devices the same rows ride the ICI all_to_all.
+    row_bytes = 4 * (2 * 3 + 1)
+    xbytes = profiling.exchange_bytes_per_step(stats, row_bytes)
+    xdomain = "ici" if n_chips > 1 else "hbm"
     _stderr(
         f"device: {n_chips} chip(s), grid {GRID}"
         + (f" as vranks {vgrid.shape}" if vgrid else "")
@@ -123,11 +131,12 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     )
     _stderr(
         f"  per-step {per_step*1e3:.2f} ms; migration/step "
-        f"{sent.mean()/total:.3%} (backlog {backlog}, dropped {dropped})"
+        f"{sent.mean()/total:.3%} (backlog {backlog}, dropped {dropped}); "
+        f"exchange {xbytes/1e6:.2f} MB/step ({xdomain})"
     )
     if dropped:
         _stderr("  WARNING: arrivals dropped — raise slab headroom")
-    return total / per_step, n_chips
+    return total / per_step, n_chips, xbytes, xdomain, per_step
 
 
 def time_cpu_oracle(n_total: int, migration: float, n_steps: int = 5,
@@ -183,7 +192,9 @@ def main() -> None:
     s2 = int(os.environ.get("BENCH_S2", 72))
     baseline_n = int(os.environ.get("BENCH_BASELINE_N", 2**21))
 
-    pps, n_chips = time_device_pipeline(n_local, migration, s1, s2)
+    pps, n_chips, xbytes, xdomain, per_step = time_device_pipeline(
+        n_local, migration, s1, s2
+    )
     pps_per_chip = pps / n_chips
     _stderr(f"device pipeline: {pps:.3e} particles/s aggregate")
 
@@ -210,6 +221,14 @@ def main() -> None:
                 "unit": "particles/s",
                 "vs_baseline": round(pps / cpu_pps, 3),
                 "vs_our_native_cpu": round(pps / cpu_native_pps, 3),
+                "ms_per_step": round(per_step * 1e3, 3),
+                # BASELINE metric's second half: exchange bandwidth. On a
+                # single chip the vrank exchange never leaves HBM
+                # (exchange_domain = "hbm"); on >=8 chips the same rows
+                # ride the ICI all_to_all (= "ici").
+                "exchange_bytes_per_step": round(xbytes, 1),
+                "exchange_bytes_per_sec": round(xbytes / per_step, 1),
+                "exchange_domain": xdomain,
             }
         )
     )

@@ -137,6 +137,17 @@ class GridRedistribute:
             self._mesh = mesh_lib.make_mesh(self.grid)
         return self._mesh
 
+    @property
+    def _vranks(self) -> bool:
+        """True when the R-rank grid runs as vmapped virtual ranks on one
+        device (fewer devices than ranks, no explicit mesh) — same
+        semantics, bit-identical outputs, no cluster needed (SURVEY.md §2
+        process-grid topology; the TPU answer to ``mpirun -n R`` on one
+        node)."""
+        if self.backend != "jax" or self._mesh is not None:
+            return False
+        return len(jax.devices()) < self.nranks
+
     def _capacities(self, n_local: int) -> Tuple[int, int]:
         cap = self.capacity
         if cap is None:
@@ -223,6 +234,24 @@ class GridRedistribute:
                 tuple(fields_out),
                 counts_out,
                 exchange.RedistributeStats(**stats),
+            )
+        if self._vranks:
+            R = self.nranks
+            n_local = positions.shape[0] // R
+            fn = exchange.build_redistribute_vranks(
+                self.domain, self.grid, cap, out_cap
+            )
+            out = fn(
+                positions.reshape(R, n_local, -1),
+                count,
+                *(f.reshape((R, n_local) + f.shape[1:]) for f in fields),
+            )
+            unstack = lambda a: a.reshape((R * out_cap,) + a.shape[2:])
+            return RedistributeResult(
+                unstack(out[0]),
+                tuple(unstack(f) for f in out[2:-1]),
+                out[1],
+                out[-1],
             )
         fn = exchange.build_redistribute(
             self.mesh, self.domain, self.grid, cap, out_cap, len(fields)

@@ -6,6 +6,23 @@ BASELINE size (1B particles) needs a v5e-64 pod's aggregate HBM
 (SURVEY.md §7.6); ``BENCH_SCALE`` sizes the local stand-in, and the layout
 / program are identical — pod runs are a config change only.
 
+HBM budget at the full 1B / v5e-64 target (SURVEY.md §7.6, VERDICT r1
+item 7) — why a SINGLE-round exchange fits and chunking is not needed:
+
+  * resident fused state: pos(3) + vel(3) + alive(1) = 7 f32 = 28 B/row;
+    at fill 0.9 that is 31.1 B per live particle.
+  * per chip: 1e9 / 64 = 15.6M particles -> 486 MB resident.
+  * transients in the migrate step: dest keys + sort operands (int32
+    [slots] each) and the budget-sized migrant buffers — measured peak
+    under ~4x the resident state, i.e. < 2 GB per chip.
+  * v5e HBM is 16 GB: >8x headroom. Single-round exchange is the right
+    design up to ~100M particles/chip (~3.5 GB resident); only past that
+    would a chunked multi-round exchange (split the migrant pack into
+    k sequential all_to_alls) pay its extra latency.
+
+One dev chip as 64 vranks caps out earlier — 1B rows would need 31 GB —
+so local runs size down via ``BENCH_SCALE``, identical program.
+
 Workload: drift loop at ~2% migration/step, as the headline bench.
 """
 
@@ -42,8 +59,11 @@ def run(n_local: int = None, migration: float = 0.02) -> dict:
         v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
     ).astype(np.float32)
     cap = max(64, math.ceil(fill * n_local * migration / 4.0 * 1.5))
+    # on-device compact-routing budget: total migrants per vrank-step
+    budget = max(256, math.ceil(fill * n_local * migration * 1.3))
     cfg = nbody.DriftConfig(
-        domain=domain, grid=dev_grid, dt=1.0, capacity=cap, n_local=n_local
+        domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
+        n_local=n_local, local_budget=budget,
     )
     pos, vel, alive = (
         jax.device_put(jnp.asarray(pos)),

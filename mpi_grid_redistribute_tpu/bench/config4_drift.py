@@ -35,8 +35,11 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
     ).astype(np.float32)
     distinct = sum(1 if g == 2 else 2 for g in grid_shape)
     cap = max(64, math.ceil(fill * n_local * migration / distinct * 1.3))
+    # on-device compact-routing budget: total migrants per vrank-step
+    budget = max(256, math.ceil(fill * n_local * migration * 1.3))
     cfg = nbody.DriftConfig(
-        domain=domain, grid=dev_grid, dt=1.0, capacity=cap, n_local=n_local
+        domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
+        n_local=n_local, local_budget=budget,
     )
     pos, vel, alive = (
         jax.device_put(jnp.asarray(pos)),

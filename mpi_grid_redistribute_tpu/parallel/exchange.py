@@ -112,6 +112,94 @@ def shard_redistribute_fn(
     return fn
 
 
+def vrank_redistribute_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+):
+    """R-rank canonical exchange on ONE device (virtual ranks, vmapped).
+
+    Semantically identical to :func:`shard_redistribute_fn` over an R-way
+    mesh — same binning, same stable pack, same Alltoallv receive order,
+    same capacity/overflow accounting — but the ranks are vmapped slabs on
+    a single device and the ``lax.all_to_all`` becomes the transpose it
+    would perform on the wire ([V_src, V_dst, C, ...] ->
+    [V_dst, V_src, C, ...]). Bit-compatible with the oracle (tested), so a
+    single chip can run — and honestly benchmark — the full canonical
+    pipeline at any R (the TPU answer to ``mpirun -n R`` on one node;
+    SURVEY.md §2 process-grid topology).
+
+    Signature: ``(pos[V, n, D], count[V], *fields[V, n, ...]) ->
+    (pos_out[V, out_capacity, D], count_out[V], fields_out..., stats)``.
+    """
+    V = grid.nranks
+
+    def fn(pos, count, *fields):
+        n = pos.shape[1]
+        me_ids = jnp.arange(V, dtype=jnp.int32)
+
+        def pack_one(pos_v, count_v, me, *fields_v):
+            iota = jnp.arange(n, dtype=jnp.int32)
+            valid = iota < count_v
+            dest = binning.rank_of_position(pos_v, domain, grid)
+            dest = jnp.where(valid, dest, V).astype(jnp.int32)
+            is_self = valid & (dest == me)
+            dest_remote = jnp.where(is_self, V, dest)
+            order, remote_counts, _ = binning.sorted_dest_counts(
+                dest_remote, V
+            )
+            dropped_send = jnp.sum(jnp.maximum(remote_counts - capacity, 0))
+            send_counts = jnp.minimum(remote_counts, capacity)
+            packed = pack.pack_by_destination(
+                dest_remote, remote_counts, (pos_v,) + tuple(fields_v),
+                capacity, order=order,
+            )
+            needed = jnp.max(remote_counts).astype(jnp.int32)
+            return packed, send_counts, is_self, dropped_send, needed
+
+        packed, send_counts, is_self, dropped_send, needed = jax.vmap(
+            pack_one
+        )(pos, count, me_ids, *fields)
+        # the wire, as a transpose: [V_src, V_dst, C, ...] -> dst-major
+        recv = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), packed)
+        recv_counts = send_counts.T  # [V_dst, V_src]
+
+        def compact_one(recv_v, recv_counts_v, me, self_mask_v, pos_v,
+                        *fields_v):
+            return pack.compact_with_self(
+                recv_v, recv_counts_v, (pos_v,) + tuple(fields_v),
+                self_mask_v, me, out_capacity,
+            )
+
+        out, new_count, dropped_recv = jax.vmap(compact_one)(
+            recv, recv_counts, me_ids, is_self, pos, *fields
+        )
+        self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
+        self_diag = jnp.diag(self_count)
+        stats = RedistributeStats(
+            send_counts=send_counts + self_diag,
+            recv_counts=recv_counts + self_diag,
+            dropped_send=dropped_send.astype(jnp.int32),
+            dropped_recv=dropped_recv,
+            needed_capacity=needed,
+        )
+        return (out[0], new_count) + tuple(out[1:]) + (stats,)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute_vranks(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+):
+    """jit of :func:`vrank_redistribute_fn` (single-device, [V, n, ...])."""
+    return jax.jit(vrank_redistribute_fn(domain, grid, capacity, out_capacity))
+
+
 @functools.lru_cache(maxsize=64)
 def build_redistribute(
     mesh: Mesh,

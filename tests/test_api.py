@@ -134,6 +134,20 @@ def test_overflow_raise_mode(rng):
         GridRedistribute(DOMAIN, (2, 2, 2), on_overflow="retry")
 
 
+def test_more_ranks_than_devices_runs_as_vranks(rng):
+    # a 16-rank grid on 8 devices: the jax backend transparently runs the
+    # canonical exchange as vmapped virtual ranks on one device,
+    # bit-identical to the oracle (SURVEY.md §2 process-grid topology)
+    pos, ids, vel = _inputs(rng, R=16, n_local=100)
+    kw = dict(domain=DOMAIN, grid=(4, 4, 1), capacity_factor=3.0)
+    rd = GridRedistribute(backend="jax", **kw)
+    assert rd._vranks
+    res_j = rd.redistribute(pos, ids, vel)
+    res_n = redistribute(pos, ids, vel, backend="numpy", **kw)
+    _compare(res_j, res_n)
+    assert int(np.asarray(res_j.count).sum()) == pos.shape[0]
+
+
 def test_periodic_domain(rng):
     dom = Domain(0.0, 1.0, periodic=True)
     pos, _, _ = _inputs(rng)

@@ -2,6 +2,8 @@
 
 import os
 
+import numpy as np
+
 import pytest
 
 os.environ.setdefault("BENCH_SCALE", "0.01")
@@ -20,7 +22,11 @@ def test_config2_clustered():
 
     out = config2_clustered.run(n_local=256, max_rounds=64)
     assert out["dropped_recv"] == 0
-    assert out["population_imbalance"] >= 1.0
+    assert out["ownership_imbalance"] >= 1.0
+    # tiny CPU smoke: scan differencing can be noise-dominated, so only
+    # presence/finiteness of the steady-state fields is asserted here
+    for k in ("pps_imbalanced", "pps_uniform_ref", "imbalanced_over_uniform"):
+        assert np.isfinite(out[k])
 
 
 def test_config3_slab():

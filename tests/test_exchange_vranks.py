@@ -1,0 +1,57 @@
+"""Single-device vrank canonical exchange == NumPy oracle, bit level.
+
+The vrank variant (parallel/exchange.vrank_redistribute_fn) emulates R
+ranks of the canonical Alltoallv-ordered exchange on one device; its
+outputs must be byte-identical to the padded oracle, like the shard_map
+path (SURVEY.md §7.4's canonical-order contract).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu import oracle
+from mpi_grid_redistribute_tpu.parallel import exchange
+
+
+@pytest.mark.parametrize("grid_shape", [(2, 2, 2), (4, 2, 1), (1, 1, 1)])
+@pytest.mark.parametrize("clustered", [False, True])
+def test_vrank_exchange_matches_oracle_bitlevel(rng, grid_shape, clustered):
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    n_local, cap, out_cap = 300, 120, 400
+    n = R * n_local
+    if clustered:
+        pos = (rng.lognormal(-1.5, 0.5, size=(n, 3)) % 1.0).astype(np.float32)
+    else:
+        pos = rng.random((n, 3)).astype(np.float32)
+    vel = rng.standard_normal((n, 3)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int32)
+    count = rng.integers(0, n_local + 1, size=R).astype(np.int32)
+
+    fn = exchange.build_redistribute_vranks(domain, grid, cap, out_cap)
+    out = fn(
+        jnp.asarray(pos).reshape(R, n_local, 3),
+        jnp.asarray(count),
+        jnp.asarray(vel).reshape(R, n_local, 3),
+        jnp.asarray(ids).reshape(R, n_local),
+    )
+    pos_v, count_v, vel_v, ids_v, stats = out
+
+    pos_o, count_o, (vel_o, ids_o), stats_o = oracle.redistribute_oracle_padded(
+        domain, grid, pos, count, [vel, ids], cap, out_cap
+    )
+    assert np.asarray(pos_v).reshape(-1, 3).tobytes() == pos_o.tobytes()
+    assert np.asarray(vel_v).reshape(-1, 3).tobytes() == vel_o.tobytes()
+    assert np.asarray(ids_v).reshape(-1).tobytes() == ids_o.tobytes()
+    np.testing.assert_array_equal(np.asarray(count_v), count_o)
+    np.testing.assert_array_equal(np.asarray(stats.send_counts),
+                                  stats_o["send_counts"])
+    np.testing.assert_array_equal(np.asarray(stats.dropped_send),
+                                  stats_o["dropped_send"])
+    np.testing.assert_array_equal(np.asarray(stats.dropped_recv),
+                                  stats_o["dropped_recv"])
+    np.testing.assert_array_equal(np.asarray(stats.needed_capacity),
+                                  stats_o["needed_capacity"])

@@ -1,0 +1,88 @@
+"""Multi-host surface (VERDICT round-1 item 10): construction-level tests
+for make_hybrid_mesh and initialize_distributed on the virtual CPU mesh.
+
+Real DCN/multi-slice hardware is not reachable here; these tests pin down
+what can be pinned: hybrid meshes build, validate, and run the exchange on
+8 virtual devices, and the distributed bring-up passthrough initializes a
+single-process "cluster" in a subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+
+def test_hybrid_mesh_all_ones_reduces_to_plain(_devices):
+    grid = ProcessGrid((2, 2, 2))
+    mesh = mesh_lib.make_hybrid_mesh(grid)
+    mesh_lib.validate_mesh_for_grid(mesh, grid)
+    assert tuple(mesh.devices.shape) == (2, 2, 2)
+
+
+def test_hybrid_mesh_dcn_split(_devices):
+    # dcn_shape=(2,1,1): axis x spans 2 "slices" of 4 devices each. On the
+    # virtual CPU platform every device reports the same process/slice, so
+    # mesh_utils may either build the hybrid layout or reject it — both
+    # are valid constructions to pin; what must hold is: a returned mesh
+    # has the right shape and axis names and passes validation.
+    grid = ProcessGrid((2, 2, 2))
+    try:
+        mesh = mesh_lib.make_hybrid_mesh(grid, dcn_shape=(2, 1, 1))
+    except (ValueError, AssertionError) as e:
+        pytest.skip(f"hybrid layout rejected on virtual devices: {e}")
+    mesh_lib.validate_mesh_for_grid(mesh, grid)
+    assert tuple(mesh.devices.shape) == (2, 2, 2)
+
+
+def test_hybrid_mesh_rejects_indivisible():
+    grid = ProcessGrid((2, 2, 2))
+    with pytest.raises(ValueError, match="not divisible"):
+        mesh_lib.make_hybrid_mesh(grid, dcn_shape=(3, 1, 1))
+    with pytest.raises(ValueError, match="axes"):
+        mesh_lib.make_hybrid_mesh(grid, dcn_shape=(2, 1))
+
+
+def test_exchange_runs_on_hybrid_mesh(rng, _devices):
+    from mpi_grid_redistribute_tpu import GridRedistribute
+
+    grid = ProcessGrid((2, 2, 2))
+    mesh = mesh_lib.make_hybrid_mesh(grid)
+    rd = GridRedistribute(
+        Domain(0.0, 1.0), (2, 2, 2), mesh=mesh, capacity_factor=3.0
+    )
+    pos = rng.random((8 * 64, 3)).astype(np.float32)
+    res = rd.redistribute(pos)
+    assert int(np.asarray(res.count).sum()) == 8 * 64
+
+
+def test_initialize_distributed_single_process():
+    # jax.distributed.initialize mutates global state; exercise it in a
+    # subprocess so the test session's backend stays untouched.
+    code = (
+        "import os;"
+        "os.environ['XLA_FLAGS']=os.environ.get('XLA_FLAGS','')"
+        "+' --xla_force_host_platform_device_count=8';"
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "from mpi_grid_redistribute_tpu.parallel import mesh as m;"
+        "m.initialize_distributed(coordinator_address='localhost:12399',"
+        "num_processes=1, process_id=0);"
+        "assert jax.process_count() == 1;"
+        "from mpi_grid_redistribute_tpu.domain import ProcessGrid;"
+        "mesh = m.make_mesh(ProcessGrid((2, 2, 2)));"
+        "print('distributed-init-ok', len(mesh.devices.ravel()))"
+    )
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "distributed-init-ok 8" in out.stdout
