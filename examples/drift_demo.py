@@ -122,9 +122,6 @@ def main() -> None:
 
     # --- 3. optional density plot ---------------------------------------
     if args.plot:
-        from mpi_grid_redistribute_tpu.ops import deposit as deposit_lib
-        from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
-
         dep_cfg = nbody.DriftConfig(
             domain=domain, grid=dev_grid, dt=0.0, capacity=cap,
             n_local=out_cap, deposit_shape=(64, 64, 64),

@@ -130,8 +130,11 @@ def run(n_total: int = None, reps: int = 3) -> dict:
         "value": round(vR * n_loc / per_step, 2),
         "unit": "particles/s",
         "bit_equal_vs_oracle": True,
-        "n_total": n_total,
+        "n_total": n_total,  # one-shot bit-equality check population
         "ranks": R,
+        # the canonical scan loop sizes itself independently (>=1024
+        # rows/vrank); 'value' is rows/sec over THIS population
+        "canonical_rows": vR * n_loc,
         "canonical_ms_per_step": round(per_step * 1e3, 3),
         "canonical_vranks": vR,
     }

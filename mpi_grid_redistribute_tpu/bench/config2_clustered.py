@@ -25,7 +25,6 @@ import os
 
 import numpy as np
 
-from mpi_grid_redistribute_tpu.api import _next_pow2
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.models import nbody
 from mpi_grid_redistribute_tpu.bench import common
@@ -106,14 +105,18 @@ def run(
     # ---- phase 2: steady-state drift throughput, imbalanced vs uniform
     # Slab size comes from the measured hottest subdomain (nothing may
     # drop); total rows identical in both runs so pps compares honestly.
-    total = R * n_base // 2
+    # lognormal(-1.0, 1.5) mod 1 concentrates ~7x the mean load on the
+    # hottest subdomain (the VERDICT's "vranks holding up to ~8x mean");
+    # the hot slab then holds ~11% of ALL rows, so total is sized to keep
+    # the uniform-slab state within HBM.
+    total = R * n_base // 4
     cluster_rows = (
-        rng.lognormal(0.0, sigma, size=(total, 3)) % 1.0
+        rng.lognormal(-1.0, 1.5, size=(total, 3)) % 1.0
     ).astype(np.float32)
     owner = binning.rank_of_position(cluster_rows, domain, full_grid, xp=np)
     counts = np.bincount(owner, minlength=R)
     imbalance = float(counts.max() / counts.mean())
-    n_slab = _next_pow2(math.ceil(counts.max() * 1.3))
+    n_slab = -(-math.ceil(counts.max() * 1.3) // 4096) * 4096
     v_scale = migration / 3.0 * 2.0 / np.asarray(grid_shape, np.float32)
 
     # capacities sized to the hot slab's migrant flux
@@ -168,6 +171,9 @@ def run(
         "imbalanced_over_uniform": round(pps_imb / pps_uni, 3),
         "ownership_imbalance": round(imbalance, 3),
         "dropped_recv": dropped_c + dropped_u,
+        # placement phase is lossless by contract (backlog retries instead
+        # of dropping); surfaced separately so it is actually checked
+        "placement_dropped_recv": summary["dropped_recv"],
         "placement_pps": placement_pps,
         "placement_rounds": rounds,
         "n_total": total,

@@ -43,6 +43,7 @@ not bit-equality; use :mod:`exchange` when canonical order matters.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Sequence
 
 import jax
@@ -51,6 +52,19 @@ from jax import lax
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
+
+
+def _land_scatter(flat, targets, rows):
+    """The landing row-scatter; switchable to the Pallas streamed-overlay
+    kernel (ops/pallas_scatter) via MPI_GRID_PALLAS_SCATTER=1 on TPU.
+    Read at trace time."""
+    if os.environ.get("MPI_GRID_PALLAS_SCATTER") == "1" and (
+        jax.devices()[0].platform in ("tpu", "axon")
+    ):
+        from mpi_grid_redistribute_tpu.ops import pallas_scatter
+
+        return pallas_scatter.scatter_rows(flat, targets, rows)
+    return flat.at[targets].set(rows, mode="drop")
 
 
 class MigrateStats(NamedTuple):
@@ -625,8 +639,8 @@ def shard_migrate_vranks_fn(
         rows_w = jnp.where(
             (k_idx[None, :] < n_in_local[:, None])[..., None], rows_w, 0.0
         )
-        flat = flat.at[gtargets.reshape(-1)].set(
-            rows_w.reshape(-1, K), mode="drop"
+        flat = _land_scatter(
+            flat, gtargets.reshape(-1), rows_w.reshape(-1, K)
         )
 
         # ---- free-stack update (contiguous window blend) --------------

@@ -1,0 +1,52 @@
+"""Time ops.pallas_scatter vs XLA's row scatter on the current device."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.ops import pallas_scatter as ps
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def main():
+    n_rows = int(os.environ.get("N_ROWS", 8 * (1 << 20)))
+    p = int(os.environ.get("P", 196608))
+    k = 7
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.random((n_rows, k), dtype=np.float32))
+    targets = jnp.asarray(
+        rng.choice(n_rows, size=p, replace=False).astype(np.int32)
+    )
+    rows = jnp.asarray(rng.random((p, k), dtype=np.float32))
+
+    out = ps.scatter_rows(flat, targets, rows)
+    want = flat.at[targets].set(rows, mode="drop")
+    print("correct:", bool(jnp.array_equal(out, want)))
+
+    for name, impl in (
+        ("pallas", lambda f, t, r: ps.scatter_rows(f, t, r)),
+        ("xla", lambda f, t, r: f.at[t].set(r, mode="drop")),
+    ):
+        def make_loop(S, impl=impl):
+            @jax.jit
+            def loop(flat, targets, rows):
+                def body(f, _):
+                    return impl(f, targets, rows), ()
+                f, _ = lax.scan(body, flat, None, length=S)
+                return f
+            return loop
+
+        per, _, _ = profiling.scan_time_per_step(
+            make_loop, (flat, targets, rows), s1=4, s2=24
+        )
+        print(f"{name}: {per*1e3:.2f} ms for {p} rows into [{n_rows},{k}]")
+
+
+if __name__ == "__main__":
+    main()

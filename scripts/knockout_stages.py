@@ -1,0 +1,231 @@
+"""Knockout profiling of shard_migrate_vranks_fn: time the step truncated
+after each phase (cumulative), at bench-identical shapes on one device.
+
+Phase deltas attribute the full step's time to real code, not to isolated
+microbenches (which can differ from what XLA emits in context — e.g. the
+vmapped scatter microbench costs 2x the flat scatter the step uses).
+
+Usage: python scripts/knockout_stages.py [n_local]
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.parallel import migrate
+from mpi_grid_redistribute_tpu.utils import profiling
+
+GRID = (2, 2, 2)
+FILL = 0.9
+MIGRATION = 0.02
+
+
+def truncated_step(domain, vgrid, C, M, n, phase):
+    """Body of the vrank migrate step (Dev=1), cut after ``phase``."""
+    V = vgrid.nranks
+    R_total = V
+    P = M
+
+    def fn(state):
+        fused, free_stack, n_free = state
+        K = fused.shape[2]
+        flat = fused.reshape(V * n, K)
+        my_v = jnp.arange(V, dtype=jnp.int32)
+
+        def dep_out(*arrs):
+            # fold a tiny dependency into the carry so nothing is DCE'd
+            d = jnp.float32(0)
+            for a in arrs:
+                d = d + a.ravel()[0].astype(jnp.float32) * jnp.float32(1e-38)
+            fused2 = fused.at[0, 0, 0].add(d)
+            return migrate.MigrateState(fused2, free_stack, n_free)
+
+        def bin_one(f, v_id):
+            alive = f[:, -1] > 0.5
+            cell = binning.cell_of_position(
+                binning.wrap_periodic(f[:, :3], domain), domain, vgrid
+            )
+            dest_v = binning.rank_of_cell(cell, vgrid)
+            staying = dest_v == v_id
+            leaving = alive & ~staying
+            return jnp.where(leaving, dest_v, R_total).astype(jnp.int32)
+
+        dest_key = jax.vmap(bin_one)(fused, my_v)
+        if phase == 1:
+            return dep_out(dest_key)
+
+        order, counts, bounds = jax.vmap(
+            lambda k: binning.sorted_dest_counts(k, R_total)
+        )(dest_key)
+        if phase == 2:
+            return dep_out(order, counts, bounds)
+
+        loc_counts = counts[:, :V]
+        loc_starts = bounds[:, :V]
+        rel_start = loc_starts - loc_starts[:, :1]
+        rel_end = rel_start + loc_counts
+        eff = jnp.clip(
+            jnp.minimum(rel_end, M) - jnp.minimum(rel_start, M), 0
+        ).astype(jnp.int32)
+        swap = jnp.minimum(eff, eff.T).astype(jnp.int32)
+        swap = migrate._greedy_alloc(
+            swap, jnp.full((V,), M, jnp.int32)
+        ).astype(jnp.int32)
+        swap = jnp.minimum(swap, swap.T)
+        res_eff = eff - swap
+        res = jnp.zeros_like(eff)
+        for _ in range(V):
+            cap_res = jnp.minimum(
+                M - jnp.sum(swap, axis=0),
+                n_free + jnp.sum(res, axis=1),
+            ).astype(jnp.int32)
+            res = migrate._greedy_alloc(
+                res_eff, jnp.maximum(cap_res, 0)
+            ).astype(jnp.int32)
+        allowed = swap + res
+        sent_local = jnp.sum(allowed, axis=1).astype(jnp.int32)
+        n_in_local = jnp.sum(allowed, axis=0).astype(jnp.int32)
+        n_sent = sent_local
+        if phase == 3:
+            return dep_out(allowed, n_sent, n_in_local)
+
+        vacated, _tot = jax.vmap(
+            lambda ss, sc, o: migrate._plan_rows(ss, sc, o, P)
+        )(loc_starts, allowed, order)
+        if phase == 4:
+            return dep_out(vacated)
+
+        cumA = jnp.concatenate(
+            [jnp.zeros((1, V), jnp.int32), jnp.cumsum(allowed, axis=0)]
+        )
+        j = jnp.arange(M, dtype=jnp.int32)
+
+        def arr_plan(w):
+            cum = cumA[:, w]
+            s = jnp.clip(
+                jnp.searchsorted(cum, j, side="right").astype(jnp.int32) - 1,
+                0, V - 1,
+            )
+            pos = loc_starts[s, w] + (j - cum[s])
+            row = order[s, jnp.clip(pos, 0, n - 1)]
+            return s * n + row
+
+        arr_src = jax.vmap(arr_plan)(my_v)
+        arr_rows = jnp.take(flat, arr_src.reshape(-1), axis=0).reshape(
+            V, M, K
+        )
+        if phase == 5:
+            return dep_out(arr_rows)
+
+        k_idx = jnp.arange(P, dtype=jnp.int32)
+
+        def land_plan(vac, nin, nsent, nf):
+            n_pop = jnp.clip(nin - nsent, 0, nf)
+            pop_idx = jnp.clip(nf - 1 - (k_idx - nsent), 0, n - 1)
+            target = jnp.where(
+                k_idx < jnp.minimum(nin, nsent),
+                vac,
+                jnp.where(
+                    (k_idx >= nsent) & (k_idx < nsent + n_pop),
+                    jnp.zeros((), jnp.int32),
+                    jnp.where((k_idx >= nin) & (k_idx < nsent), vac, n),
+                ),
+            )
+            return target, n_pop, pop_idx
+
+        targets, n_pop, pop_idx = jax.vmap(land_plan)(
+            vacated, n_in_local, n_sent, n_free
+        )
+        pops = jnp.take_along_axis(free_stack, pop_idx, axis=1)
+        use_pop = (k_idx[None, :] >= n_sent[:, None]) & (
+            k_idx[None, :] < (n_sent + n_pop)[:, None]
+        )
+        targets = jnp.where(use_pop, pops, targets)
+        gtargets = jnp.where(
+            targets >= n, V * n, my_v[:, None] * n + targets
+        )
+        if phase == 6:
+            return dep_out(gtargets)
+
+        rows_w = jnp.where(
+            (k_idx[None, :] < n_in_local[:, None])[..., None], arr_rows, 0.0
+        )
+        flat2 = flat.at[gtargets.reshape(-1)].set(
+            rows_w.reshape(-1, K), mode="drop"
+        )
+        if phase == 7:
+            f2 = flat2.reshape(V, n, K)
+            return migrate.MigrateState(f2, free_stack, n_free)
+
+        n_push = jnp.maximum(n_sent - n_in_local, 0)
+        free_stack2, n_free2 = jax.vmap(migrate._stack_push_pop)(
+            free_stack, n_free, n_pop, n_push, vacated, n_in_local
+        )
+        return migrate.MigrateState(
+            flat2.reshape(V, n, K), free_stack2, n_free2
+        )
+
+    return fn
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2**20
+    V = 8
+    distinct = 3
+    C = max(64, math.ceil(FILL * n * MIGRATION / distinct * 1.3))
+    M = max(256, math.ceil(FILL * n * MIGRATION * 1.3))
+    domain = Domain(0.0, 1.0, periodic=True)
+    vgrid = ProcessGrid(GRID)
+
+    rng = np.random.default_rng(0)
+    K = 7
+    fused = rng.random((V, n, K), dtype=np.float32)
+    fused[:, :, -1] = (rng.random((V, n)) < FILL).astype(np.float32)
+    state = migrate.init_state(jax.device_put(jnp.asarray(fused)))
+
+    prev = 0.0
+    for phase in range(1, 9):
+        step = truncated_step(domain, vgrid, C, M, n, phase)
+
+        def make_loop(S, step=step):
+            @jax.jit
+            def loop(fused, free_stack, n_free):
+                st = migrate.MigrateState(fused, free_stack, n_free)
+
+                def body(st, _):
+                    # drift so dest_key changes each step
+                    f = st.fused
+                    p = f[..., :3] + f[..., 3:6] * jnp.float32(1e-4)
+                    p = binning.wrap_periodic(p, domain)
+                    f = jnp.concatenate([p, f[..., 3:]], axis=-1)
+                    st2 = step(st._replace(fused=f))
+                    return st2, ()
+
+                st, _ = lax.scan(body, st, None, length=S)
+                return st.fused
+
+            return loop
+
+        per, _, _ = profiling.scan_time_per_step(
+            make_loop, tuple(state), s1=4, s2=16
+        )
+        print(
+            f"phase {phase}: {per*1e3:7.2f} ms  (delta "
+            f"{(per - prev)*1e3:+7.2f} ms)"
+        )
+        prev = per
+
+
+if __name__ == "__main__":
+    main()

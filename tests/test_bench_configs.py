@@ -22,6 +22,7 @@ def test_config2_clustered():
 
     out = config2_clustered.run(n_local=256, max_rounds=64)
     assert out["dropped_recv"] == 0
+    assert out["placement_dropped_recv"] == 0
     assert out["ownership_imbalance"] >= 1.0
     # tiny CPU smoke: scan differencing can be noise-dominated, so only
     # presence/finiteness of the steady-state fields is asserted here
