@@ -200,12 +200,16 @@ def make_migrate_loop(
     mesh: Mesh,
     n_steps: int,
     vgrid: Optional[ProcessGrid] = None,
+    deposit_each_step: bool = False,
 ):
     """S fast-migration steps in one compiled program via ``lax.scan``.
 
     ``loop(pos, vel, alive) -> (pos, vel, alive, stats)`` with stats leaves
     stacked per step ([S, R]); with ``cfg.deposit_shape`` set, the final
-    step's global density mesh is appended.
+    step's global density mesh is appended. ``deposit_each_step=True``
+    fuses the CIC deposit into EVERY scanned step (the config-5 workload:
+    exchange + deposit in one compiled program, here on the fast
+    resident-slot engine), carrying only the latest mesh.
 
     The scan carry is the *fused* ``[n, 2D]`` payload matrix (position +
     velocity columns), fused once on entry and split once on exit, so each
@@ -247,6 +251,16 @@ def make_migrate_loop(
                 method=cfg.deposit_method,
             )
 
+    if deposit_each_step and dep_fn is None:
+        raise ValueError("cfg.deposit_shape is required for deposit")
+
+    def _deposit(fused):
+        """CIC density of a fused state ([V, n, K] or [n, K])."""
+        pv = fused[..., :D]
+        return dep_fn(
+            pv, jnp.ones(pv.shape[:-1], pv.dtype), fused[..., -1] > 0.5
+        )
+
     def shard_loop(pos, vel, alive):
         fused, specs = migrate.fuse_fields((pos, vel), alive)
         if vgrid is not None:
@@ -260,32 +274,39 @@ def make_migrate_loop(
 
         state = jax.tree.map(_vary, state)
 
-        def body(state, _):
+        def body(carry, _):
+            state = carry[0]
             f = state.fused
             p = f[..., :D] + f[..., D : 2 * D] * jnp.asarray(cfg.dt, f.dtype)
             p = binning.wrap_periodic(p, cfg.domain)
             f = jnp.concatenate([p, f[..., D:]], axis=-1)
             state, stats = mig(state._replace(fused=f))
-            return state, stats
+            new_carry = (state,)
+            if deposit_each_step:
+                new_carry = (state, _deposit(state.fused))
+            return new_carry, stats
 
-        state, stats = lax.scan(body, state, None, length=n_steps)
+        init = (state,)
+        if deposit_each_step:
+            rho0 = jnp.zeros(
+                deposit_lib.global_node_shape(cfg.domain, cfg.deposit_shape)
+                if not all(cfg.domain.periodic)
+                else tuple(
+                    m // g
+                    for m, g in zip(cfg.deposit_shape, cfg.grid.shape)
+                ),
+                jnp.float32,
+            )
+            init = (state, _vary(rho0))
+        carry, stats = lax.scan(body, init, None, length=n_steps)
+        state = carry[0]
         fused_f = state.fused
         if vgrid is not None:
             fused_f = fused_f.reshape(-1, fused_f.shape[-1])
         (pos_f, vel_f), alive_f = migrate.unfuse_fields(fused_f, specs)
         if dep_fn is None:
             return pos_f, vel_f, alive_f, stats
-        if vgrid is None:
-            rho = dep_fn(
-                pos_f, jnp.ones(pos_f.shape[:1], pos_f.dtype), alive_f
-            )
-        else:
-            pv = pos_f.reshape(V, -1, pos_f.shape[-1])
-            rho = dep_fn(
-                pv,
-                jnp.ones(pv.shape[:2], pos_f.dtype),
-                alive_f.reshape(V, -1),
-            )
+        rho = carry[1] if deposit_each_step else _deposit(state.fused)
         return pos_f, vel_f, alive_f, stats, rho
 
     # stats leaves are [S, 1] per shard (scan-stacked): shard axis 1.

@@ -5,6 +5,14 @@ Phase deltas attribute the full step's time to real code, not to isolated
 microbenches (which can differ from what XLA emits in context — e.g. the
 vmapped scatter microbench costs 2x the flat scatter the step uses).
 
+MAINTENANCE: ``truncated_step`` is a DELIBERATE copy of the Dev==1 slice
+of ``parallel/migrate.shard_migrate_vranks_fn`` with early exits — a
+truncating profiler cannot share the un-truncatable original. If the
+migrate step changes, re-sync this copy or the per-phase table in
+BENCH_CONFIGS.md describes a stale pipeline. Sanity check: phase 8 must
+match the FULL-step time from scripts/profile_stages.py / bench.py
+(52.5 vs 53.4 vs 52.7 ms when last synced).
+
 Usage: python scripts/knockout_stages.py [n_local]
 """
 
