@@ -24,7 +24,10 @@ has ~100 ms fixed round-trip latency that would otherwise swamp the signal).
 Env overrides: BENCH_N_LOCAL (particles per subdomain), BENCH_MIGRATION
 (target per-step migration fraction, default 0.02 — a
 generous rate for drift steps, which move particles well under a cell width), BENCH_S1/BENCH_S2
-(loop lengths), BENCH_BASELINE_N (CPU-oracle total particles).
+(loop lengths), BENCH_BASELINE_N (CPU-oracle total particles),
+BENCH_GRID (comma grid shape, default "2,2,2" — "4,4,4" with the default
+n_local is the BASELINE north-star 64M-particle workload, run as 64
+vranks on one chip when fewer devices exist).
 """
 
 from __future__ import annotations
@@ -37,8 +40,10 @@ import time
 
 import numpy as np
 
-GRID = (2, 2, 2)
-R = 8
+GRID = tuple(
+    int(x) for x in os.environ.get("BENCH_GRID", "2,2,2").split(",")
+)
+R = math.prod(GRID)
 
 
 def _stderr(msg: str) -> None:
@@ -53,8 +58,7 @@ def _initial_state(n_local: int, migration: float, rng):
     ~``migration`` of live rows cross a subdomain face per step (dt=1)."""
     from mpi_grid_redistribute_tpu.bench import common
 
-    # mean |v_a| * dt / cell_width ~ migration/3 per axis (3 axes ~ target)
-    v_scale = migration / 3.0 * 2.0 / np.asarray(GRID, np.float32)
+    v_scale, _, _ = common.drift_sizing(GRID, n_local, FILL, migration)
     return common.uniform_state(GRID, n_local, FILL, rng, vel_scale=v_scale)
 
 
@@ -80,14 +84,12 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
         mesh = mesh_lib.make_mesh(dev_grid, devices=devs[:1])
 
     # capacity per (source, dest) pair: migrants spread over the distinct
-    # face neighbors (periodic axes of extent 2 wrap +1 and -1 to the SAME
-    # neighbor, doubling that pair's traffic); modest headroom — spikes
-    # backlog harmlessly and retry next step
-    distinct = sum(1 if g == 2 else 2 for g in GRID)
-    cap = max(64, math.ceil(FILL * n_local * migration / distinct * 1.3))
-    # on-device routing budget: total migrants per vrank-step + headroom
-    # (compact routing costs scale with this, not with R*cap)
-    budget = max(256, math.ceil(FILL * n_local * migration * 1.3))
+    # face neighbors, modest headroom (spikes backlog harmlessly and retry
+    # next step); budget bounds the compact on-device routing
+    # (bench.common.drift_sizing is the shared sizing policy)
+    from mpi_grid_redistribute_tpu.bench import common as bcommon
+
+    _, cap, budget = bcommon.drift_sizing(GRID, n_local, FILL, migration)
     cfg = nbody.DriftConfig(
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,
@@ -95,9 +97,12 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
 
     rng = np.random.default_rng(0)
     pos, vel, alive = _initial_state(n_local, migration, rng)
+    # transfer FLAT: any [N, 3] array crossing a program boundary (even an
+    # eager reshape) materializes the tiled T(8,128) layout — 42.7x
+    # padding, 32 GB at 64M particles; the migrate loop takes flat input
     pos, vel, alive = (
-        jax.device_put(jnp.asarray(pos)),
-        jax.device_put(jnp.asarray(vel)),
+        jax.device_put(jnp.asarray(pos.reshape(-1))),
+        jax.device_put(jnp.asarray(vel.reshape(-1))),
         jax.device_put(jnp.asarray(alive)),
     )
 

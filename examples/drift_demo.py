@@ -111,6 +111,7 @@ def main() -> None:
         np.asarray,
         loop(res.positions, res.fields[0], jnp.asarray(alive)),
     )
+    p = p.reshape(-1, 3)  # the migrate loop returns pos/vel flat
     msum = stats_lib.summarize_migrate(st)
     assert int(a.sum()) == R * n_local, "conservation violated"
     stats_lib.check_no_loss(st)

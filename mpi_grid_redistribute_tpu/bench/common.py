@@ -79,6 +79,33 @@ def lognormal_state(grid_shape, n_local: int, fill: float, rng, sigma=1.0):
     return pos, alive
 
 
+def drift_sizing(
+    grid_shape, n_local: int, fill: float, migration: float,
+    headroom: float = 1.3,
+):
+    """Shared drift-loop sizing: per-axis velocity scale for ~``migration``
+    fraction of rows crossing a subdomain face per step, per-pair exchange
+    ``capacity``, and the compact-routing ``local_budget``.
+
+    Face-neighbor count per axis: extent 1 -> 0 (undecomposed), extent 2
+    -> 1 (both periodic wraps reach the SAME neighbor, doubling that
+    pair's traffic), else 2. Undecomposed axes get the mean decomposed
+    velocity scale (any speed, no migration).
+    """
+    import math
+
+    g = np.asarray(grid_shape, np.int64)
+    dec = g > 1
+    n_dec = max(int(dec.sum()), 1)
+    distinct = int(np.where(g == 1, 0, np.where(g == 2, 1, 2)).sum())
+    distinct = max(distinct, 1)
+    v = np.where(dec, migration / n_dec * 2.0 / g, 0.0)
+    v = np.where(dec, v, v[dec].mean() if dec.any() else migration)
+    cap = max(64, math.ceil(fill * n_local * migration / distinct * headroom))
+    budget = max(256, math.ceil(fill * n_local * migration * headroom))
+    return v.astype(np.float32), cap, budget
+
+
 def timeit_fetch(fn, args, reps: int = 3) -> float:
     """min wall seconds of fn(*args) with a host-fetch barrier."""
     import jax

@@ -28,7 +28,6 @@ Workload: drift loop at ~2% migration/step, as the headline bench.
 
 from __future__ import annotations
 
-import math
 import os
 
 import numpy as np
@@ -50,17 +49,13 @@ def run(n_local: int = None, migration: float = 0.02) -> dict:
     domain = Domain(0.0, 1.0, periodic=True)
     rng = np.random.default_rng(3)
     fill = 0.9
-    # velocities sized for ~`migration` fraction crossing per step (2
-    # decomposed axes of extent 8: 2 distinct neighbors each)
-    v_scale = migration / 2.0 * 2.0 / np.asarray(grid_shape, np.float32)
-    v_scale[2] = v_scale[0]  # z undecomposed: any speed, no migration
+    v_scale, cap, budget = common.drift_sizing(
+        grid_shape, n_local, fill, migration, headroom=1.5
+    )
     pos, _, alive = common.uniform_state(grid_shape, n_local, fill, rng)
     vel = (
         v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
     ).astype(np.float32)
-    cap = max(64, math.ceil(fill * n_local * migration / 4.0 * 1.5))
-    # on-device compact-routing budget: total migrants per vrank-step
-    budget = max(256, math.ceil(fill * n_local * migration * 1.3))
     cfg = nbody.DriftConfig(
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,

@@ -6,7 +6,6 @@ suite with its own knobs.
 
 from __future__ import annotations
 
-import math
 import os
 
 import numpy as np
@@ -28,15 +27,13 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
     domain = Domain(0.0, 1.0, periodic=True)
     rng = np.random.default_rng(0)
     fill = 0.9
-    v_scale = migration / 3.0 * 2.0 / np.asarray(grid_shape, np.float32)
+    v_scale, cap, budget = common.drift_sizing(
+        grid_shape, n_local, fill, migration
+    )
     pos, _, alive = common.uniform_state(grid_shape, n_local, fill, rng)
     vel = (
         v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
     ).astype(np.float32)
-    distinct = sum(1 if g == 2 else 2 for g in grid_shape)
-    cap = max(64, math.ceil(fill * n_local * migration / distinct * 1.3))
-    # on-device compact-routing budget: total migrants per vrank-step
-    budget = max(256, math.ceil(fill * n_local * migration * 1.3))
     cfg = nbody.DriftConfig(
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,

@@ -42,13 +42,12 @@ def run(n_local: int = None, mesh_cells: int = 128,
 
     fill = 0.9
     rng = np.random.default_rng(0)
-    v_scale = migration / 3.0 * 2.0 / np.asarray(grid_shape, np.float32)
+    v_scale, cap, budget = common.drift_sizing(
+        grid_shape, n_local, fill, migration
+    )
     pos, vel, alive = common.uniform_state(
         grid_shape, n_local, fill, rng, vel_scale=v_scale
     )
-    distinct = sum(1 if g == 2 else 2 for g in grid_shape)
-    cap = max(64, math.ceil(fill * n_local * migration / distinct * 1.3))
-    budget = max(256, math.ceil(fill * n_local * migration * 1.3))
     cfg = nbody.DriftConfig(
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,

@@ -204,12 +204,20 @@ def make_migrate_loop(
 ):
     """S fast-migration steps in one compiled program via ``lax.scan``.
 
-    ``loop(pos, vel, alive) -> (pos, vel, alive, stats)`` with stats leaves
-    stacked per step ([S, R]); with ``cfg.deposit_shape`` set, the final
-    step's global density mesh is appended. ``deposit_each_step=True``
-    fuses the CIC deposit into EVERY scanned step (the config-5 workload:
-    exchange + deposit in one compiled program, here on the fast
-    resident-slot engine), carrying only the latest mesh.
+    ``loop(pos, vel, alive) -> (pos_flat, vel_flat, alive, stats)`` with
+    stats leaves stacked per step ([S, R]); with ``cfg.deposit_shape``
+    set, the final step's global density mesh is appended.
+    ``deposit_each_step=True`` fuses the CIC deposit into EVERY scanned
+    step (the config-5 workload: exchange + deposit in one compiled
+    program, here on the fast resident-slot engine), carrying only the
+    latest mesh.
+
+    LAYOUT CONTRACT: ``pos``/``vel`` are accepted as ``[N, D]`` or flat
+    ``[N * D]`` and are RETURNED FLAT — a rank-2 ``[N, 3]`` array
+    materializing at a TPU program boundary is stored in the tiled
+    T(8,128) layout (42.7x padding; 32 GB at 64M particles, measured).
+    Reshape after ``np.asarray`` (free on host) or feed the flat arrays
+    straight back in.
 
     The scan carry is the *fused* ``[n, 2D]`` payload matrix (position +
     velocity columns), fused once on entry and split once on exit, so each
@@ -261,7 +269,13 @@ def make_migrate_loop(
             pv, jnp.ones(pv.shape[:-1], pv.dtype), fused[..., -1] > 0.5
         )
 
-    def shard_loop(pos, vel, alive):
+    def shard_loop(pos_flat, vel_flat, alive):
+        # inputs cross the shard_map boundary FLAT: XLA's input-conversion
+        # copy for a rank-2 [N, 3] parameter materializes in the tiled
+        # T(8,128) layout — 42.7x padding, 32 GB at 64M particles
+        # (measured); a 1-D parameter converts compactly.
+        pos = pos_flat.reshape(-1, D)
+        vel = vel_flat.reshape(-1, D)
         fused, specs = migrate.fuse_fields((pos, vel), alive)
         if vgrid is not None:
             fused = fused.reshape(V, -1, fused.shape[1])
@@ -288,22 +302,34 @@ def make_migrate_loop(
 
         init = (state,)
         if deposit_each_step:
-            rho0 = jnp.zeros(
-                deposit_lib.global_node_shape(cfg.domain, cfg.deposit_shape)
-                if not all(cfg.domain.periodic)
-                else tuple(
-                    m // g
-                    for m, g in zip(cfg.deposit_shape, cfg.grid.shape)
-                ),
-                jnp.float32,
-            )
-            init = (state, _vary(rho0))
+            if all(cfg.domain.periodic):
+                # sharded local block; ends in fold_ghosts (ppermute) ->
+                # device-varying, so the carry must start varying too
+                rho0 = _vary(jnp.zeros(
+                    tuple(
+                        m // g
+                        for m, g in zip(cfg.deposit_shape, cfg.grid.shape)
+                    ),
+                    jnp.float32,
+                ))
+            else:
+                # dense-assembled mesh; ends in assemble_dense's psum ->
+                # axis-INVARIANT, and the carry must match (a varying
+                # init would fail lax.scan's carry-type check)
+                rho0 = jnp.zeros(
+                    deposit_lib.global_node_shape(
+                        cfg.domain, cfg.deposit_shape
+                    ),
+                    jnp.float32,
+                )
+            init = (state, rho0)
         carry, stats = lax.scan(body, init, None, length=n_steps)
         state = carry[0]
         fused_f = state.fused
         if vgrid is not None:
             fused_f = fused_f.reshape(-1, fused_f.shape[-1])
         (pos_f, vel_f), alive_f = migrate.unfuse_fields(fused_f, specs)
+        pos_f, vel_f = pos_f.reshape(-1), vel_f.reshape(-1)  # flat out too
         if dep_fn is None:
             return pos_f, vel_f, alive_f, stats
         rho = carry[1] if deposit_each_step else _deposit(state.fused)
@@ -316,12 +342,22 @@ def make_migrate_loop(
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:
         out_specs = out_specs + (deposit_lib.deposit_out_spec(cfg.domain, cfg.grid),)
-    return jax.jit(
+    jitted = jax.jit(
         shard_map(
             shard_loop, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=out_specs,
         )
     )
+
+    def loop(pos, vel, alive):
+        """Accepts pos/vel as [N, D] or already-flat [N*D]; RETURNS THEM
+        FLAT ([N*D]). Any eager device-side reshape to [N, D] outside a
+        jit materializes the tiled T(8,128) layout (42.7x padding, 32 GB
+        at 64M particles — measured); reshape after np.asarray instead
+        (free on host), or keep feeding the flat arrays back in."""
+        return jitted(pos.reshape(-1), vel.reshape(-1), alive)
+
+    return loop
 
 
 def build_deposit_masked(cfg: DriftConfig, mesh: Mesh):

@@ -326,6 +326,7 @@ def test_migrate_loop_deposit_each_step(rng, _devices):
     alive = rng.random(R * n_local) > 0.2
     loop = nbody.make_migrate_loop(cfg, mesh, 3, deposit_each_step=True)
     p, v, a, st, rho = jax.tree.map(np.asarray, loop(pos, vel, alive))
+    p = p.reshape(-1, 3)
     survivors = int(a.sum())
     np.testing.assert_allclose(rho.sum(), survivors, rtol=1e-4)
     # equals a standalone deposit of the final state
@@ -346,6 +347,22 @@ def test_migrate_loop_deposit_each_step(rng, _devices):
     )
     pv, vv, av, stv, rhov = jax.tree.map(np.asarray, vloop(pos, vel, alive))
     np.testing.assert_allclose(rhov.sum(), av.sum(), rtol=1e-4)
+
+    # non-periodic variant: the dense-assembled rho ends in a psum
+    # (axis-invariant), and the scan carry must match (regression:
+    # a varying init failed lax.scan's carry-type check)
+    for per in (False, (True, True, False)):
+        odom = Domain(0.0, 1.0, periodic=per)
+        ocfg = nbody.DriftConfig(
+            domain=odom, grid=grid, dt=0.0, capacity=16, n_local=n_local,
+            deposit_shape=(8, 8, 8),
+        )
+        oloop = nbody.make_migrate_loop(ocfg, mesh, 2,
+                                        deposit_each_step=True)
+        oo = jax.tree.map(np.asarray, oloop(pos, vel, alive))
+        rho_o = oo[-1]
+        assert rho_o.shape == deposit_lib.global_node_shape(odom, (8, 8, 8))
+        np.testing.assert_allclose(rho_o.sum(), oo[2].sum(), rtol=1e-4)
 
 
 def test_vrank_deposit_matches_flat(rng, _devices):

@@ -62,6 +62,7 @@ def test_migrate_matches_reference_sets(shape, rng, _devices):
     pos_f, vel_f, alive_f, stats = jax.tree.map(
         np.asarray, loop(pos, vel, alive)
     )
+    pos_f, vel_f = pos_f.reshape(-1, 3), vel_f.reshape(-1, 3)
 
     assert stats.backlog.sum() == 0
     assert stats.dropped_recv.sum() == 0
@@ -216,6 +217,7 @@ def test_migrate_vranks_full_swap_is_lossless(rng, _devices):
     pos_f, vel_f, alive_f, stats = jax.tree.map(
         np.asarray, loop(pos, vel, alive)
     )
+    pos_f, vel_f = pos_f.reshape(-1, 3), vel_f.reshape(-1, 3)
     assert stats.dropped_recv.sum() == 0
     assert stats.backlog.sum() == 0
     assert stats.sent.sum() == n
@@ -275,6 +277,7 @@ def test_migrate_vranks_matches_reference_sets(dev_shape, v_shape, rng, _devices
     pos_f, vel_f, alive_f, stats = jax.tree.map(
         np.asarray, loop(pos, vel, alive)
     )
+    pos_f, vel_f = pos_f.reshape(-1, 3), vel_f.reshape(-1, 3)
 
     assert stats.backlog.sum() == 0
     assert stats.dropped_recv.sum() == 0
