@@ -169,15 +169,23 @@ def main():
     timed(f"arrival gather ({V}x{M_budget} rows)", make_gather_loop, fused,
           gather_idx)
 
-    # --- 4. landing scatter: [V, R*C] rows into [V, n, K] ----------------
+    # --- 4. landing scatter: flat [V*M] rows into [V*n, K] ---------------
+    # FLAT, as the real step does it: the vmapped per-vrank form measures
+    # ~2x slower than what XLA emits for the flat scatter (measured; see
+    # scripts/knockout_stages.py for in-context attribution)
     def make_scatter_loop(S):
         @jax.jit
         def loop(fused, tgt, rows):
             def body(carry, _):
                 f, t = carry
-                f = jax.vmap(
-                    lambda ff, tt, rr: ff.at[tt].set(rr, mode="drop")
-                )(f, t, rows)
+                flat = f.reshape(V * n, K)
+                gt = (
+                    jnp.arange(V, dtype=jnp.int32)[:, None] * n + t
+                ).reshape(-1)
+                flat = flat.at[gt].set(
+                    rows.reshape(-1, K), mode="drop"
+                )
+                f = flat.reshape(V, n, K)
                 dep = (f[:, :1, 0] * jnp.float32(1e-38)).astype(jnp.int32)
                 t = (t + dep) % n
                 return (f, t), ()
@@ -187,7 +195,7 @@ def main():
 
         return loop
 
-    timed(f"landing scatter ({V}x{M_budget} rows)", make_scatter_loop,
+    timed(f"landing scatter (flat {V}x{M_budget} rows)", make_scatter_loop,
           fused, target, rows)
 
     # --- 5. full migrate step (reference) --------------------------------
