@@ -16,17 +16,29 @@ Design (one compiled step, all static shapes):
   1. bin -> ``leaving`` mask (alive rows whose owner changed);
   2. ONE stable key sort groups leaving rows by destination; per-destination
      counts fall out of ``searchsorted`` on the sorted keys (no scatter-add);
-  3. migrants beyond the per-(source,dest) ``capacity`` simply STAY resident
-     and retry next step (surfaced as ``backlog`` — particles are never
-     dropped on the send side);
-  4. one fused ``[R, C, K]`` ``lax.all_to_all`` moves position + payload +
+  3. migrants beyond the per-(source,dest) ``capacity`` — or beyond what
+     the receiver GRANTS (below) — simply STAY resident and retry next
+     step (surfaced as ``backlog``; particles are never dropped);
+  4. receiver-side flow control makes the receive lossless: desired
+     per-pair counts fly first, each receiver grants pairwise swaps
+     (self-financing: a swap arrival's matching departure vacates a slot)
+     plus a greedy share of its free slots, grants fly back, and only
+     granted rows are packed — arrivals are structurally bounded by what
+     can land;
+  5. one fused ``[R, C, K]`` ``lax.all_to_all`` moves position + payload +
      alive column as a single float32 matrix (32-bit fields bitcast);
-  5. arrivals land exactly in the slots vacated by departures, then in slots
+  6. arrivals land exactly in the slots vacated by departures, then in slots
      popped from a carried free-slot *stack* (contiguous dynamic-slice
      push/pop — never a scatter); one single scatter per step writes
-     payload, alive flag, and vacancy markers together;
-  6. arrivals beyond the shard's free slots are counted in ``dropped_recv``
-     (receiver overflow is the only loss channel, and it is surfaced).
+     payload, alive flag, and vacancy markers together; ``dropped_recv``
+     remains as a surfaced safety counter and is structurally zero.
+
+Known limit of the granted scheme (both paths): a pure rotation cycle of
+length >= 3 between COMPLETELY full shards at exactly zero free slots
+stalls in ``backlog`` — pairwise swaps are zero and there are no free
+slots to grant. Any hole anywhere on the cycle drains it. Size slabs
+with headroom (every bench/demo uses fill <= 0.9); the stall is visible
+(a constant nonzero ``backlog``), never silent loss.
 
 **Virtual ranks** (:func:`shard_migrate_vranks_fn`): each device can host a
 whole sub-grid of subdomains ("vranks", vmapped slabs), so a 4x4x4 grid runs
@@ -71,14 +83,16 @@ class MigrateStats(NamedTuple):
     """Per-step migration observability (SURVEY.md §5.5). Global shapes [R]
     (one entry per rank; with vranks, device-major ``dev * V + vrank``
     order). ``backlog`` counts migrants delayed by per-pair send capacity
-    (they stay resident and retry); ``dropped_recv`` counts arrivals lost to
-    receiver free-slot exhaustion — surfaced, never silent."""
+    or by receiver grants (they stay resident and retry — never lost);
+    ``dropped_recv`` remains as a surfaced safety counter for arrivals a
+    receiver could not land, structurally zero now that sends are
+    receiver-granted."""
 
     sent: jax.Array
     received: jax.Array
     population: jax.Array
     backlog: jax.Array
-    dropped_recv: jax.Array
+    dropped_recv: jax.Array  # structurally 0 since receiver-granted sends
 
 
 class MigrateState(NamedTuple):
@@ -163,21 +177,14 @@ def _segment_of(k: jax.Array, cum: jax.Array) -> jax.Array:
     return jnp.searchsorted(cum, k, side="right").astype(jnp.int32) - 1
 
 
-def _pack_leavers(fused, dest_key, n_dest: int, capacity: int):
-    """Sort-pack leaving rows into a ``[n_dest * C, K]`` send pool.
-
-    ``dest_key`` is the destination index per row with sentinel ``n_dest``
-    for rows that stay (resident, hole, or backlogged later). Returns
-    ``(send, send_counts, gather_idx, backlog)`` where ``send`` is zero in
-    invalid slots and ``gather_idx[j]`` is the resident row feeding send
-    slot ``j`` (unique over valid slots).
-    """
-    n, K = fused.shape
+def _pack_rows(fused, order, bounds, send_counts, n_dest: int,
+               capacity: int):
+    """Gather the first ``send_counts[d]`` sorted rows of each destination
+    segment into a ``[n_dest * C, K]`` send pool (zero in invalid slots).
+    Returns ``(send, gather_idx)``; ``gather_idx[j]`` is the resident row
+    feeding send slot ``j`` (unique over valid slots)."""
+    n = fused.shape[0]
     C = capacity
-    order, full_counts, bounds = binning.sorted_dest_counts(dest_key, n_dest)
-    send_counts = jnp.minimum(full_counts, C)
-    backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
-
     c_idx = jnp.arange(C, dtype=jnp.int32)
     flat_c = jnp.tile(c_idx, n_dest)
     flat_d = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), C)
@@ -187,7 +194,7 @@ def _pack_leavers(fused, dest_key, n_dest: int, capacity: int):
     send = jnp.where(
         slot_valid[:, None], jnp.take(fused, gather_idx, axis=0), 0.0
     )
-    return send, send_counts, gather_idx, backlog
+    return send, gather_idx
 
 
 def _stack_push_pop(free_stack, n_free, n_pop, n_push, vacated, n_in):
@@ -315,11 +322,35 @@ def shard_migrate_fused_fn(
         # Sentinel R: holes and staying residents sort to the tail.
         dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
 
-        send, send_counts, gather_idx, backlog = _pack_leavers(
-            fused, dest_key, R, C
+        order, full_counts, bounds = binning.sorted_dest_counts(dest_key, R)
+        desired = jnp.minimum(full_counts, C).astype(jnp.int32)
+
+        # Receiver-side flow control (lossless receive): exchange DESIRED
+        # counts, let each receiver grant what it can land, send only the
+        # granted rows; the rest stay resident and retry (backlog).
+        # Grant = pairwise swaps (self-financing: each swap arrival has a
+        # matching departure vacating a slot — both sides compute the same
+        # symmetric min) + a greedy share of the free slots. Arrivals are
+        # then structurally <= swaps + n_free, so the landing never drops.
+        recv_desired = lax.all_to_all(
+            desired, axes, split_axis=0, concat_axis=0, tiled=True
         )
-        recv_counts = lax.all_to_all(
-            send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+        swap = jnp.minimum(recv_desired, desired)
+        resid = _greedy_alloc(
+            (recv_desired - swap)[:, None],
+            jnp.maximum(n_free, 0)[None],
+        )[:, 0].astype(jnp.int32)
+        grants = swap + resid  # what I allow each source to send me
+        grants_back = lax.all_to_all(
+            grants, axes, split_axis=0, concat_axis=0, tiled=True
+        )
+        send_counts = jnp.minimum(desired, grants_back)
+        backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
+        # actual arrivals are known locally: min(their desire, my grant)
+        recv_counts = jnp.minimum(recv_desired, grants)
+
+        send, gather_idx = _pack_rows(
+            fused, order, bounds, send_counts, R, C
         )
         recv = lax.all_to_all(
             send.reshape(R, C, K), axes, split_axis=0, concat_axis=0,
@@ -406,9 +437,14 @@ def shard_migrate_vranks_fn(
       ever dropping an arrival.
     * **Cross-device traffic** rides a ``[Dev, V, V, C, K]``
       ``lax.all_to_all`` over ICI, ``capacity`` rows per (source vrank,
-      destination vrank) pair; receiver overflow there is counted in
-      ``dropped_recv`` (the wire cannot be un-sent). When ``Dev == 1`` the
-      collective and its buffers compile away entirely.
+      destination vrank) pair, and is **receiver-granted**: desired counts
+      fly first, each destination vrank greedily grants within its free
+      slots, grants fly back, and only granted rows are packed — excess
+      movers backlog instead of ever hitting a full receiver (the wire
+      never carries what cannot land; ``dropped_recv`` stays a safety
+      counter). Mutually-full vranks on different devices trade through
+      backlog (no cross-device swap financing). When ``Dev == 1`` the
+      collectives and their buffers compile away entirely.
 
     Signature of the returned per-shard fn:
       ``MigrateState -> (MigrateState, MigrateStats)``
@@ -479,18 +515,45 @@ def shard_migrate_vranks_fn(
             0,
         ).astype(jnp.int32)
 
-        # remote send counts first: they vacate slots independently of the
-        # local allocation, so they seed the receiver-capacity fixpoint
+        # remote sends first: they vacate slots independently of the local
+        # allocation, so they seed the receiver-capacity fixpoint. With
+        # Dev > 1 the sends are RECEIVER-GRANTED (lossless receive): the
+        # desired per-pair counts fly first, each destination vrank
+        # greedily grants within its pre-step free slots, the grants fly
+        # back, and only granted rows are packed — ungranted rows stay
+        # resident and retry (backlog). Remote arrivals are then
+        # structurally <= n_free and the remote landing never drops.
+        # (Unlike the flat path there is no cross-device swap financing —
+        # the remote landing pops free slots only — so mutually-full
+        # vranks on different devices trade through backlog.)
         if Dev > 1:
-            rem_sent_full = jnp.minimum(counts, C).astype(jnp.int32)
+            desired_rem = jnp.minimum(counts, C).astype(jnp.int32)
             g_ids = jnp.arange(R_total, dtype=jnp.int32)
             is_local_g = (g_ids >= loc0) & (g_ids < loc0 + V)
-            rem_sent_full = jnp.where(
-                is_local_g[None, :], 0, rem_sent_full
+            desired_rem = jnp.where(
+                is_local_g[None, :], 0, desired_rem
             )  # [V_src, R_total]
+            # desired -> receiver (same transpose layout as the payload)
+            desired_t = desired_rem.reshape(V, Dev, V).transpose(1, 0, 2)
+            recv_desired = lax.all_to_all(
+                desired_t, axes, split_axis=0, concat_axis=0, tiled=True
+            ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_dst, S_global]
+            grants = _greedy_alloc(
+                recv_desired.T, jnp.maximum(n_free, 0)
+            ).T.astype(jnp.int32)  # [V_dst, S_global]
+            # grants -> sender (reverse layout)
+            grants_t = grants.reshape(V, Dev, V).transpose(1, 0, 2)
+            grants_back = lax.all_to_all(
+                grants_t, axes, split_axis=0, concat_axis=0, tiled=True
+            ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_src, G_dst]
+            rem_sent_full = jnp.minimum(desired_rem, grants_back)
             sent_remote = jnp.sum(rem_sent_full, axis=1).astype(jnp.int32)
+            # actual arrivals are known locally: min(desire, grant)
+            recv_counts_rem = jnp.minimum(recv_desired, grants)
+            n_in_rem = jnp.sum(recv_counts_rem, axis=1).astype(jnp.int32)
         else:
             sent_remote = jnp.zeros((V,), jnp.int32)
+            n_in_rem = jnp.zeros((V,), jnp.int32)
 
         # Receiver capacity: arrivals may use current free slots PLUS slots
         # vacated by the receiver's own sends this step — otherwise
@@ -512,10 +575,14 @@ def shard_migrate_vranks_fn(
         swap = jnp.minimum(swap, swap.T)
         res_eff = eff - swap
         res = jnp.zeros_like(eff)
+        # free slots already promised to granted remote arrivals are off
+        # the table for local arrivals (remote lands after local and only
+        # pops the stack)
+        n_free_local = n_free - n_in_rem
         for _ in range(V):
             cap_res = jnp.minimum(
                 M - jnp.sum(swap, axis=0),
-                n_free + sent_remote + jnp.sum(res, axis=1),
+                n_free_local + sent_remote + jnp.sum(res, axis=1),
             ).astype(jnp.int32)
             res = _greedy_alloc(res_eff, jnp.maximum(cap_res, 0)).astype(
                 jnp.int32
@@ -548,18 +615,13 @@ def shard_migrate_vranks_fn(
             )
             # [V_src, Dev, V_dst, C, K] -> [Dev, V_src, V_dst, C, K]
             send = send.reshape(V, Dev, V, C, K).transpose(1, 0, 2, 3, 4)
-            counts_t = cnt_sg.reshape(V, Dev, V).transpose(1, 0, 2)
             recv = lax.all_to_all(
                 send, axes, split_axis=0, concat_axis=0, tiled=True
             )
-            recv_counts_rem = lax.all_to_all(
-                counts_t, axes, split_axis=0, concat_axis=0, tiled=True
-            )
-            # per-dst pools: [V_dst, Dev_src * V_src * C, K]
+            # per-dst pools: [V_dst, Dev_src * V_src * C, K]; arrival
+            # counts (recv_counts_rem) were derived locally in the grant
+            # phase — no extra counts exchange needed
             recv = recv.transpose(2, 0, 1, 3, 4).reshape(V, Dev * V * C, K)
-            recv_counts_rem = recv_counts_rem.transpose(2, 0, 1).reshape(
-                V, Dev * V
-            )
 
         n_sent = sent_local + sent_remote
 
