@@ -346,8 +346,10 @@ def shard_migrate_fused_fn(
         )
         send_counts = jnp.minimum(desired, grants_back)
         backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
-        # actual arrivals are known locally: min(their desire, my grant)
-        recv_counts = jnp.minimum(recv_desired, grants)
+        # actual arrivals == my grants: grants <= recv_desired by
+        # construction (swap and resid are both bounded by it), and each
+        # sender sends exactly what I granted it
+        recv_counts = grants
 
         send, gather_idx = _pack_rows(
             fused, order, bounds, send_counts, R, C
@@ -548,8 +550,9 @@ def shard_migrate_vranks_fn(
             ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_src, G_dst]
             rem_sent_full = jnp.minimum(desired_rem, grants_back)
             sent_remote = jnp.sum(rem_sent_full, axis=1).astype(jnp.int32)
-            # actual arrivals are known locally: min(desire, grant)
-            recv_counts_rem = jnp.minimum(recv_desired, grants)
+            # actual arrivals == my grants (greedy allocates within each
+            # source's desire, so grants <= recv_desired always)
+            recv_counts_rem = grants
             n_in_rem = jnp.sum(recv_counts_rem, axis=1).astype(jnp.int32)
         else:
             sent_remote = jnp.zeros((V,), jnp.int32)

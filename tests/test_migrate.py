@@ -337,3 +337,42 @@ def test_vranks_cross_device_receive_is_lossless(rng, _devices):
     # only `free` movers could land; the rest are backlogged
     assert stats.sent.sum() == free
     assert stats.backlog.sum() == n_local - free
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_migrate_random_pressure_conserves(seed, _devices):
+    """Fuzz: random fills, velocities and capacities — alive count is
+    invariant and nothing ever drops, on both the flat multi-device path
+    and the vrank two-tier path (grant-protocol safety net)."""
+    rng = np.random.default_rng(seed)
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = int(rng.integers(24, 72))
+    cap = int(rng.integers(2, 10))
+
+    # flat path: 8 devices
+    grid = ProcessGrid((2, 2, 2))
+    n = grid.nranks * n_local
+    pos = rng.random((n, 3)).astype(np.float32)
+    vel = (rng.random((n, 3)).astype(np.float32) - 0.5) * 0.8
+    alive = rng.random(n) < rng.uniform(0.3, 1.0)
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=grid, dt=0.3, capacity=cap, n_local=n_local
+    )
+    mesh = mesh_lib.make_mesh(grid)
+    loop = nbody.make_migrate_loop(cfg, mesh, 6)
+    _, _, a1, st = jax.tree.map(np.asarray, loop(pos, vel, alive))
+    assert st.dropped_recv.sum() == 0
+    assert a1.sum() == alive.sum()
+
+    # vrank two-tier path: 2 devices x 4 vranks
+    dev_grid = ProcessGrid((2, 1, 1))
+    vgrid = ProcessGrid((2, 2, 1))
+    vmesh = mesh_lib.make_mesh(dev_grid, devices=jax.devices()[:2])
+    vcfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=0.3, capacity=cap,
+        n_local=n_local, local_budget=int(rng.integers(8, 64)),
+    )
+    vloop = nbody.make_migrate_loop(vcfg, vmesh, 6, vgrid=vgrid)
+    _, _, a2, st2 = jax.tree.map(np.asarray, vloop(pos, vel, alive))
+    assert st2.dropped_recv.sum() == 0
+    assert a2.sum() == alive.sum()
