@@ -171,10 +171,20 @@ def init_state(fused: jax.Array) -> MigrateState:
 
 
 def _segment_of(k: jax.Array, cum: jax.Array) -> jax.Array:
-    """For flat output position(s) ``k``, the segment index under exclusive
-    cumulative counts ``cum`` ([R+1], cum[0]=0): the d with
-    cum[d] <= k < cum[d+1]. Pure searchsorted — no scatter."""
-    return jnp.searchsorted(cum, k, side="right").astype(jnp.int32) - 1
+    """For output position(s) ``k`` (any shape, k >= 0), the segment index
+    under exclusive cumulative counts ``cum`` ([n_segs+1], cum[0]=0): the
+    d with cum[d] <= k < cum[d+1]. Comparison-count against the cum
+    table — ``jnp.searchsorted``'s default TPU lowering is a sequential
+    per-query scan (measured 200+ ms at 5M queries; the fix bought the
+    headline 52 -> 45 ms/step). Use only for cum tables that stay small
+    (O(V)); for tables scaling with total rank count prefer
+    ``jnp.searchsorted(..., method="sort")``."""
+    k = jnp.asarray(k)
+    return jnp.sum(
+        cum[(None,) * k.ndim + (slice(1, None),)] <= k[..., None],
+        axis=-1,
+        dtype=jnp.int32,
+    )
 
 
 def _pack_rows(fused, order, bounds, send_counts, n_dest: int,
@@ -404,7 +414,7 @@ def _plan_rows(seg_starts, seg_counts, order, length: int):
     )
     j = jnp.arange(length, dtype=jnp.int32)
     seg = jnp.clip(
-        jnp.searchsorted(cum, j, side="right").astype(jnp.int32) - 1,
+        _segment_of(j, cum),
         0,
         seg_counts.shape[0] - 1,
     )
@@ -654,12 +664,7 @@ def shard_migrate_vranks_fn(
 
         def arr_plan(w):
             cum = cumA[:, w]
-            s = jnp.clip(
-                jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
-                - 1,
-                0,
-                V - 1,
-            )
+            s = jnp.clip(_segment_of(j, cum), 0, V - 1)
             pos = loc_starts[s, w] + (j - cum[s])
             row = order[s, jnp.clip(pos, 0, n - 1)]
             return s * n + row  # [M] global source rows
@@ -725,10 +730,13 @@ def shard_migrate_vranks_fn(
                     [jnp.zeros((1,), jnp.int32), jnp.cumsum(rcnt)]
                 ).astype(jnp.int32)
                 nin = cum[-1]
+                # cum here has Dev*V + 1 entries (scales with the whole
+                # machine): comparison-count would do O(Dev*V) work per
+                # query, so use the merge-sort searchsorted lowering
                 s = jnp.clip(
-                    jnp.searchsorted(cum, kr, side="right").astype(
-                        jnp.int32
-                    )
+                    jnp.searchsorted(
+                        cum, kr, side="right", method="sort"
+                    ).astype(jnp.int32)
                     - 1,
                     0,
                     Dev * V - 1,

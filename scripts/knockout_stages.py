@@ -121,10 +121,7 @@ def truncated_step(domain, vgrid, C, M, n, phase):
 
         def arr_plan(w):
             cum = cumA[:, w]
-            s = jnp.clip(
-                jnp.searchsorted(cum, j, side="right").astype(jnp.int32) - 1,
-                0, V - 1,
-            )
+            s = jnp.clip(migrate._segment_of(j, cum), 0, V - 1)
             pos = loc_starts[s, w] + (j - cum[s])
             row = order[s, jnp.clip(pos, 0, n - 1)]
             return s * n + row
