@@ -101,8 +101,8 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     # eager reshape) materializes the tiled T(8,128) layout — 42.7x
     # padding, 32 GB at 64M particles; the migrate loop takes flat input
     pos, vel, alive = (
-        jax.device_put(jnp.asarray(pos.reshape(-1))),
-        jax.device_put(jnp.asarray(vel.reshape(-1))),
+        jax.device_put(jnp.asarray(nbody.rows_to_planar(pos, mesh.size))),
+        jax.device_put(jnp.asarray(nbody.rows_to_planar(vel, mesh.size))),
         jax.device_put(jnp.asarray(alive)),
     )
 

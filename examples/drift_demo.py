@@ -109,9 +109,13 @@ def main() -> None:
     ).reshape(-1)
     p, v, a, st = jax.tree.map(
         np.asarray,
-        loop(res.positions, res.fields[0], jnp.asarray(alive)),
+        loop(
+            nbody.rows_to_planar(np.asarray(res.positions), mesh.size),
+            nbody.rows_to_planar(np.asarray(res.fields[0]), mesh.size),
+            jnp.asarray(alive),
+        ),
     )
-    p = p.reshape(-1, 3)  # the migrate loop returns pos/vel flat
+    p = nbody.planar_to_rows(p, 3, mesh.size)  # loop returns planar flat
     msum = stats_lib.summarize_migrate(st)
     assert int(a.sum()) == R * n_local, "conservation violated"
     stats_lib.check_no_loss(st)

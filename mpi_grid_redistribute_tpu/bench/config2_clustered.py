@@ -133,8 +133,8 @@ def run(
             v_scale * (rng.random(pos_np.shape, dtype=np.float32) * 2 - 1)
         ).astype(np.float32)
         args = (
-            jax.device_put(jnp.asarray(pos_np)),
-            jax.device_put(jnp.asarray(vel_np)),
+            jax.device_put(jnp.asarray(nbody.rows_to_planar(pos_np, mesh.size))),
+            jax.device_put(jnp.asarray(nbody.rows_to_planar(vel_np, mesh.size))),
             jax.device_put(jnp.asarray(alive_np)),
         )
         per_step, _, long_out = profiling.scan_time_per_step(

@@ -38,9 +38,11 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,
     )
+    # pack planar on the host (free): no [N, 3] buffer ever lands on
+    # device (T(8,128) pads it 42.7x; see nbody.rows_to_planar)
     pos, vel, alive = (
-        jax.device_put(jnp.asarray(pos)),
-        jax.device_put(jnp.asarray(vel)),
+        jax.device_put(jnp.asarray(nbody.rows_to_planar(pos, mesh.size))),
+        jax.device_put(jnp.asarray(nbody.rows_to_planar(vel, mesh.size))),
         jax.device_put(jnp.asarray(alive)),
     )
     per_step, _, _out = profiling.scan_time_per_step(

@@ -54,8 +54,8 @@ def run(n_local: int = None, mesh_cells: int = 128,
         deposit_shape=dshape, deposit_method="scan",
     )
     args = (
-        jax.device_put(jnp.asarray(pos)),
-        jax.device_put(jnp.asarray(vel)),
+        jax.device_put(jnp.asarray(nbody.rows_to_planar(pos, mesh.size))),
+        jax.device_put(jnp.asarray(nbody.rows_to_planar(vel, mesh.size))),
         jax.device_put(jnp.asarray(alive)),
     )
     per_step, _, long_out = profiling.scan_time_per_step(

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
@@ -204,25 +205,34 @@ def make_migrate_loop(
 ):
     """S fast-migration steps in one compiled program via ``lax.scan``.
 
-    ``loop(pos, vel, alive) -> (pos_flat, vel_flat, alive, stats)`` with
-    stats leaves stacked per step ([S, R]); with ``cfg.deposit_shape``
+    ``loop(pos, vel, alive) -> (pos_planar, vel_planar, alive, stats)``
+    with stats leaves stacked per step ([S, R]); with ``cfg.deposit_shape``
     set, the final step's global density mesh is appended.
     ``deposit_each_step=True`` fuses the CIC deposit into EVERY scanned
     step (the config-5 workload: exchange + deposit in one compiled
     program, here on the fast resident-slot engine), carrying only the
     latest mesh.
 
-    LAYOUT CONTRACT: ``pos``/``vel`` are accepted as ``[N, D]`` or flat
-    ``[N * D]`` and are RETURNED FLAT — a rank-2 ``[N, 3]`` array
-    materializing at a TPU program boundary is stored in the tiled
-    T(8,128) layout (42.7x padding; 32 GB at 64M particles, measured).
-    Reshape after ``np.asarray`` (free on host) or feed the flat arrays
-    straight back in.
+    LAYOUT CONTRACT (struct-of-arrays): ``pos``/``vel`` are accepted as
+    ``[N, D]`` host arrays (transposed for free on the host) or as
+    PLANAR component-major flat arrays ``[D * N]`` (all x's, then all
+    y's, ...; see :func:`rows_to_planar`), and are RETURNED PLANAR FLAT
+    (:func:`planar_to_rows` recovers ``[N, D]`` on the host). Any
+    row-major ``[N, D]`` device buffer — even a transient reshape at the
+    program boundary — materializes in the tiled T(8,128) layout (42.7x
+    padding; 32 GB at 64M particles, measured: the reshape alone OOMs
+    the 16 GB chip), so the loop's device interface is planar end to
+    end.
 
-    The scan carry is the *fused* ``[n, 2D]`` payload matrix (position +
-    velocity columns), fused once on entry and split once on exit, so each
-    step moves migrants with a single gather/all_to_all/scatter
-    (:mod:`..parallel.migrate`).
+    The scan carry is the *fused* PLANAR ``[2D+1, n]`` payload matrix
+    (position + velocity component rows + alive row; particles on the lane
+    axis), fused once on entry and split once on exit, so each step moves
+    migrants with a single gather/all_to_all/scatter
+    (:mod:`..parallel.migrate`). The planar orientation is what lets the
+    scan carry stay COMPACT — a ``[n, K]`` carry materializes in the tiled
+    T(8,128) layout (18x padding at K=7; the round-2 single-chip cap at
+    ~16-32M particles), while ``[K, n]`` pads only 8/7 on the sublane
+    axis, so the 64M-particle north-star fits one chip.
 
     With ``vgrid``, each device hosts ``V = vgrid.nranks`` subdomain slabs
     of the full ``cfg.grid.shape * vgrid.shape`` grid (virtual ranks —
@@ -263,23 +273,36 @@ def make_migrate_loop(
         raise ValueError("cfg.deposit_shape is required for deposit")
 
     def _deposit(fused):
-        """CIC density of a fused state ([V, n, K] or [n, K])."""
-        pv = fused[..., :D]
-        return dep_fn(
-            pv, jnp.ones(pv.shape[:-1], pv.dtype), fused[..., -1] > 0.5
-        )
+        """CIC density of a planar fused state ([K, V*n] or [K, n]).
+
+        The deposit library takes row-major ``[.., n, D]`` positions, so
+        this transposes — materializing a narrow-minor buffer in the
+        tiled T(8,128) layout (42.7x padding for [n, 3]). Fine at
+        config-5 scales (~7.5M rows -> ~3.8 GB transient); the deposit
+        path is not part of the 64M planar north-star."""
+        if vgrid is not None:
+            pv = fused[:D, :].reshape(D, V, -1).transpose(1, 2, 0)
+            valid = fused[-1, :].reshape(V, -1) > 0.5
+        else:
+            pv = fused[:D, :].T
+            valid = fused[-1, :] > 0.5
+        return dep_fn(pv, jnp.ones(pv.shape[:-1], pv.dtype), valid)
 
     def shard_loop(pos_flat, vel_flat, alive):
-        # inputs cross the shard_map boundary FLAT: XLA's input-conversion
-        # copy for a rank-2 [N, 3] parameter materializes in the tiled
-        # T(8,128) layout — 42.7x padding, 32 GB at 64M particles
-        # (measured); a 1-D parameter converts compactly.
-        pos = pos_flat.reshape(-1, D)
-        vel = vel_flat.reshape(-1, D)
-        fused, specs = migrate.fuse_fields((pos, vel), alive)
-        if vgrid is not None:
-            fused = fused.reshape(V, -1, fused.shape[1])
-        state = migrate.init_state(fused)
+        # inputs cross the shard_map boundary as PLANAR flat arrays
+        # (component-major [D * n]): a 1-D parameter converts compactly
+        # and the reshape to [D, n] splits the MAJOR axis — no row-major
+        # [n, D] buffer ever exists on device (the T(8,128) input copy of
+        # one is 42.7x padded: 32 GB at 64M particles, measured).
+        fused = jnp.concatenate(
+            [
+                pos_flat.reshape(D, -1),
+                vel_flat.reshape(D, -1),
+                alive.astype(jnp.float32)[None, :],
+            ],
+            axis=0,
+        )
+        state = migrate.init_state(fused, vranks=V)
         # scan requires carry leaves already marked device-varying (some
         # init_state outputs are iota-derived and start unvaried)
         def _vary(x):
@@ -290,10 +313,10 @@ def make_migrate_loop(
 
         def body(carry, _):
             state = carry[0]
-            f = state.fused
-            p = f[..., :D] + f[..., D : 2 * D] * jnp.asarray(cfg.dt, f.dtype)
-            p = binning.wrap_periodic(p, cfg.domain)
-            f = jnp.concatenate([p, f[..., D:]], axis=-1)
+            f = state.fused  # planar [K, m]
+            p = f[:D, :] + f[D : 2 * D, :] * jnp.asarray(cfg.dt, f.dtype)
+            p = binning.wrap_periodic_planar(p, cfg.domain)
+            f = jnp.concatenate([p, f[D:, :]], axis=0)
             state, stats = mig(state._replace(fused=f))
             new_carry = (state,)
             if deposit_each_step:
@@ -325,11 +348,12 @@ def make_migrate_loop(
             init = (state, rho0)
         carry, stats = lax.scan(body, init, None, length=n_steps)
         state = carry[0]
-        fused_f = state.fused
-        if vgrid is not None:
-            fused_f = fused_f.reshape(-1, fused_f.shape[-1])
-        (pos_f, vel_f), alive_f = migrate.unfuse_fields(fused_f, specs)
-        pos_f, vel_f = pos_f.reshape(-1), vel_f.reshape(-1)  # flat out too
+        # planar exit: row-slices of the fused matrix, flattened
+        # component-major — again no [n, D] buffer materializes
+        f = state.fused
+        pos_f = f[:D, :].reshape(-1)
+        vel_f = f[D : 2 * D, :].reshape(-1)
+        alive_f = f[-1, :] > 0.5
         if dep_fn is None:
             return pos_f, vel_f, alive_f, stats
         rho = carry[1] if deposit_each_step else _deposit(state.fused)
@@ -349,15 +373,60 @@ def make_migrate_loop(
         )
     )
 
+    n_blocks = mesh.size
+
     def loop(pos, vel, alive):
-        """Accepts pos/vel as [N, D] or already-flat [N*D]; RETURNS THEM
-        FLAT ([N*D]). Any eager device-side reshape to [N, D] outside a
-        jit materializes the tiled T(8,128) layout (42.7x padding, 32 GB
-        at 64M particles — measured); reshape after np.asarray instead
-        (free on host), or keep feeding the flat arrays back in."""
-        return jitted(pos.reshape(-1), vel.reshape(-1), alive)
+        """Accepts pos/vel as [N, D] HOST arrays (converted to the planar
+        device format for free via :func:`rows_to_planar`) or as planar
+        flat [D*N] arrays (the canonical device format; shard-major
+        component-major — what this loop RETURNS). Recover [N, D] rows on
+        the host with ``planar_to_rows(out, D, mesh.size)``. A 2-D
+        DEVICE array is rejected: it already materialized the 42.7x
+        padded T(8,128) layout — build planar arrays host-side instead.
+        """
+
+        def to_planar(a):
+            if a.ndim == 1:
+                return a
+            if isinstance(a, np.ndarray):
+                return rows_to_planar(a, n_blocks)
+            raise TypeError(
+                "make_migrate_loop: pass device arrays in planar flat "
+                "format (rows_to_planar); a [N, D] device buffer is "
+                "already stored 42.7x padded (T(8,128))"
+            )
+
+        return jitted(to_planar(pos), to_planar(vel), alive)
 
     return loop
+
+
+def rows_to_planar(a, n_blocks: int):
+    """Host-side pack of row-major ``[N, D]`` particle data into the
+    migrate loop's planar device format: shard-major blocks (``n_blocks``
+    = mesh device count), component-major within each block (all x's of
+    the block, then all y's, ...). Free on the host; avoids ever placing
+    a narrow-minor ``[N, D]`` buffer on the TPU (42.7x T(8,128) padding,
+    measured). ``n_blocks`` is REQUIRED and must equal ``mesh.size`` —
+    a wrong block count packs other shards' components into each shard
+    with no error to catch it."""
+    a = np.asarray(a)
+    n, d = a.shape
+    if n % n_blocks:
+        raise ValueError(f"rows {n} not divisible by n_blocks {n_blocks}")
+    return np.ascontiguousarray(
+        a.reshape(n_blocks, n // n_blocks, d).transpose(0, 2, 1)
+    ).reshape(-1)
+
+
+def planar_to_rows(a, ndim: int, n_blocks: int):
+    """Inverse of :func:`rows_to_planar`: planar flat ``[D * N]`` back to
+    row-major ``[N, D]`` on the host."""
+    a = np.asarray(a)
+    n = a.size // (ndim * n_blocks)
+    return np.ascontiguousarray(
+        a.reshape(n_blocks, ndim, n).transpose(0, 2, 1)
+    ).reshape(-1, ndim)
 
 
 def build_deposit_masked(cfg: DriftConfig, mesh: Mesh):

@@ -333,6 +333,18 @@ def cic_deposit_vranks_sorted(
     """
     V, n, ndim = pos.shape
     n_cells = math.prod(vblock)
+    # The flat segment key is v * n_cells + cell (int32) and the prefix
+    # tables materialize [V * n_cells + 1] vectors — guard both before
+    # they silently overflow / allocate GBs (round-2 advisor). Realistic
+    # per-device subgrids are ~1e5-1e6 cells; 2**27 keys ~= 0.5 GB of
+    # int32 tables is already past any sane configuration.
+    if V * n_cells > 2**27:
+        raise ValueError(
+            f"cic_deposit_vranks_sorted: V * prod(vblock) = {V} * "
+            f"{n_cells} = {V * n_cells} exceeds the safe int32/memory "
+            f"bound (2**27). Use a coarser deposit grid per vrank, fewer "
+            f"vranks per device, or the vmapped per-vrank path."
+        )
     rel = (pos - lo_local[:, None, :]) * inv_h
     rel = jnp.where(valid[..., None], rel, 0.0)
     i0 = jnp.clip(

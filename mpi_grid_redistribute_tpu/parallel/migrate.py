@@ -4,12 +4,24 @@ The general :mod:`exchange` path re-packs every particle into canonical MPI
 ``Alltoallv`` receive order each step — full-array gathers plus a pool-wide
 stable sort. Profiling on the real chip shows the true TPU cost model:
 
-  * random-access scatter costs ~85 ns *per row* regardless of row width
-    (a [4M,6] scatter of 256k rows is ~22 ms) — scatters must be few and
-    sized to the data actually moved;
+  * random-access scatter costs ~76-85 ns *per row* regardless of row width
+    (measured in BOTH layouts; see below) — scatters must be few and sized
+    to the data actually moved;
   * ``segment_sum`` histograms lower to scatter-add (~37 ms at 4M) — counts
     must come from ``searchsorted`` on already-sorted keys instead;
   * a full stable sort of 4M int32 keys is ~6 ms; elementwise binning ~3 ms.
+
+**Planar layout** (round 3): the fused state is carried TRANSPOSED —
+``[K, n]`` float32, components on the sublane axis, particles on the lane
+axis — because TPU stores any narrow-minor ``[n, K]`` buffer that
+materializes at a program boundary or scan carry in the tiled ``T(8,128)``
+layout: ``[n, 7]`` pads 128/7 = 18x (32 GB at 64M rows — the round-2 cap
+on the single-chip north-star run). ``[K, n]`` pads only 8/ceil(K) on the
+sublane axis (1.14x at K=7). Measured layout costs on the v5e-class chip
+(scripts/microbench_layout.py, n=8.4M, P=262k): column gather 25.2 vs row
+gather 17.6 ns/row; column scatter 76.1 vs row scatter 84.8 ns/row —
+i.e. the planar layout is performance-neutral for the hot ops while
+removing the 18x memory padding entirely.
 
 Design (one compiled step, all static shapes):
 
@@ -25,32 +37,40 @@ Design (one compiled step, all static shapes):
      plus a greedy share of its free slots, grants fly back, and only
      granted rows are packed — arrivals are structurally bounded by what
      can land;
-  5. one fused ``[R, C, K]`` ``lax.all_to_all`` moves position + payload +
-     alive column as a single float32 matrix (32-bit fields bitcast);
+  5. one fused ``[R, K, C]`` ``lax.all_to_all`` moves position + payload +
+     alive row as a single float32 matrix (32-bit fields bitcast);
   6. arrivals land exactly in the slots vacated by departures, then in slots
      popped from a carried free-slot *stack* (contiguous dynamic-slice
      push/pop — never a scatter); one single scatter per step writes
      payload, alive flag, and vacancy markers together; ``dropped_recv``
      remains as a surfaced safety counter and is structurally zero.
 
-Known limit of the granted scheme (both paths): a pure rotation cycle of
-length >= 3 between COMPLETELY full shards at exactly zero free slots
-stalls in ``backlog`` — pairwise swaps are zero and there are no free
-slots to grant. Any hole anywhere on the cycle drains it. Size slabs
-with headroom (every bench/demo uses fill <= 0.9); the stall is visible
-(a constant nonzero ``backlog``), never silent loss.
+**Rotation-cycle liveness** (round-3; was a documented stall in round 2):
+the least fixpoint of the self-financing grant recursion is zero on a pure
+rotation cycle of length >= 3 between COMPLETELY full shards at zero free
+slots — pairwise swaps are zero and there is nothing to grant. Both paths
+now detect such cycles (:func:`_cycle_rescue`: functional graph of first
+pending destinations over totally-stalled shards, boolean-closure cycle
+detection) and force ONE granted row along each cycle edge per step; the
+forced arrival lands in the slot the member's own forced departure
+vacates, so the rescue is lossless with zero free slots and the cycle
+drains at one row per member per step. Remaining limit: with multiple
+devices, a cycle that SPANS devices on the vrank path is not rescued (the
+remote landing tier has no vacated-slot financing) — those cycles still
+backlog visibly; any hole anywhere on the cycle drains them.
 
 **Virtual ranks** (:func:`shard_migrate_vranks_fn`): each device can host a
-whole sub-grid of subdomains ("vranks", vmapped slabs), so a 4x4x4 grid runs
-on 8 chips — or on one — with identical semantics: the per-vrank pack/land
-phases vmap, and the cross-device hop is one ``lax.all_to_all`` on the
-``[D, V_src, V_dst, C, K]`` buffer; vrank-to-vrank traffic on the same
+whole sub-grid of subdomains ("vranks", slabs side by side on the lane
+axis), so a 4x4x4 grid runs on 8 chips — or on one — with identical
+semantics: the cross-device hop is one ``lax.all_to_all`` on the
+``[Dev, V_src, V_dst, K, C]`` buffer; vrank-to-vrank traffic on the same
 device never leaves HBM. This is the TPU answer to running an R-rank MPI
 job on fewer nodes (SURVEY.md §2 process-grid topology, §7.6 scale).
 
 Slot order is *not* the MPI canonical order — arrivals fill arbitrary holes.
-Correctness is therefore set-equality per shard against the oracle (tested),
-not bit-equality; use :mod:`exchange` when canonical order matters.
+Correctness is therefore set-equality per shard against the oracle (tested
+at the BIT level: the engine only ever moves rows), not order-equality; use
+:mod:`exchange` when canonical MPI receive order matters.
 """
 
 from __future__ import annotations
@@ -66,17 +86,34 @@ from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
 
 
-def _land_scatter(flat, targets, rows):
-    """The landing row-scatter; switchable to the Pallas streamed-overlay
-    kernel (ops/pallas_scatter) via MPI_GRID_PALLAS_SCATTER=1 on TPU.
-    Read at trace time."""
-    if os.environ.get("MPI_GRID_PALLAS_SCATTER") == "1" and (
+def _resolve_pallas_scatter(pallas_scatter) -> bool:
+    """Resolve the landing-scatter implementation choice at BUILD time.
+
+    ``None`` (the default) consults MPI_GRID_PALLAS_SCATTER=1 once, when the
+    builder runs — not inside the traced function, where jit caching (keyed
+    on shapes only) would freeze the first value seen and make later env
+    changes silently ineffective (round-2 advisor). Passing an explicit
+    bool overrides the env entirely, so two settings can coexist in one
+    process via two builders."""
+    if pallas_scatter is None:
+        pallas_scatter = os.environ.get("MPI_GRID_PALLAS_SCATTER") == "1"
+    return bool(pallas_scatter) and (
         jax.devices()[0].platform in ("tpu", "axon")
-    ):
+    )
+
+
+def _land_scatter(flat, targets, cols, use_pallas: bool = False):
+    """The landing column-scatter on planar ``[K, m]`` state; ``use_pallas``
+    selects the Pallas streamed-overlay kernel (ops/pallas_scatter, a
+    documented negative result kept for its platform findings) — resolved
+    by the builder via :func:`_resolve_pallas_scatter`, never read from the
+    env here. The Pallas kernel takes row-major buffers, so that branch
+    pays two transposes on top of its already-losing per-row stores."""
+    if use_pallas:
         from mpi_grid_redistribute_tpu.ops import pallas_scatter
 
-        return pallas_scatter.scatter_rows(flat, targets, rows)
-    return flat.at[targets].set(rows, mode="drop")
+        return pallas_scatter.scatter_rows(flat.T, targets, cols.T).T
+    return flat.at[:, targets].set(cols, mode="drop")
 
 
 class MigrateStats(NamedTuple):
@@ -98,10 +135,12 @@ class MigrateStats(NamedTuple):
 class MigrateState(NamedTuple):
     """Scan-carry state for the fused migration loop.
 
-    ``fused`` is ``[n, K]`` float32 (``[V, n, K]`` with vranks): position
-    columns, payload columns, and an alive column last. ``free_stack`` /
-    ``n_free`` are the hole-slot stack (indices of dead rows; only the first
-    ``n_free`` entries are live)."""
+    ``fused`` is PLANAR ``[K, n]`` float32 (``[K, V * n]`` with V vranks —
+    vrank ``v`` owns lane columns ``[v * n, (v + 1) * n)``): position
+    component rows first, payload rows, and the alive row last.
+    ``free_stack`` / ``n_free`` are the hole-slot stack (indices of dead
+    columns; only the first ``n_free`` entries are live), per vrank
+    (``[V, n]`` / ``[V]``) on the vrank path."""
 
     fused: jax.Array
     free_stack: jax.Array
@@ -109,11 +148,12 @@ class MigrateState(NamedTuple):
 
 
 def fuse_fields(arrays: Sequence[jax.Array], alive: jax.Array):
-    """Pack [n, ...] arrays + alive mask into one [n, K] float32 matrix.
+    """Pack [n, ...] arrays + alive mask into one PLANAR [K, n] float32
+    matrix (components on the sublane axis — see module docstring).
 
     32-bit dtypes are bitcast; the fused matrix only ever moves bytes
     (gather/scatter/all_to_all), so bit patterns survive exactly. The alive
-    mask becomes the last column (1.0/0.0).
+    mask becomes the last row (1.0/0.0).
 
     Returns ``(fused, specs)``; ``specs`` drives :func:`unfuse_fields`.
     """
@@ -128,45 +168,52 @@ def fuse_fields(arrays: Sequence[jax.Array], alive: jax.Array):
         flat = a.reshape(n, -1)
         if flat.dtype != jnp.float32:
             flat = lax.bitcast_convert_type(flat, jnp.float32)
-        parts.append(flat)
+        parts.append(flat.T)
         specs.append((a.shape[1:], a.dtype))
-    parts.append(alive.astype(jnp.float32)[:, None])
-    return jnp.concatenate(parts, axis=1), tuple(specs)
+    parts.append(alive.astype(jnp.float32)[None, :])
+    return jnp.concatenate(parts, axis=0), tuple(specs)
 
 
 def unfuse_fields(fused: jax.Array, specs):
     """Inverse of :func:`fuse_fields`: ``(arrays..., alive)``."""
     out = []
-    col = 0
-    n = fused.shape[0]
+    row = 0
+    n = fused.shape[1]
     for shape, dtype in specs:
         k = 1
         for s in shape:
             k *= s
-        flat = fused[:, col : col + k]
+        flat = fused[row : row + k, :].T
         if dtype != jnp.float32:
             flat = lax.bitcast_convert_type(flat, dtype)
         out.append(flat.reshape((n,) + tuple(shape)))
-        col += k
-    alive = fused[:, -1] > 0.5
+        row += k
+    alive = fused[-1, :] > 0.5
     return tuple(out), alive
 
 
-def init_state(fused: jax.Array) -> MigrateState:
-    """Build the free-slot stack from the fused matrix's alive column.
+def init_state(fused: jax.Array, vranks: int = 1) -> MigrateState:
+    """Build the free-slot stack from the fused matrix's alive row.
 
     One-time cost (a full argsort) at loop entry; the stack is maintained
-    incrementally afterwards. Works on ``[n, K]`` or vmapped ``[V, n, K]``.
+    incrementally afterwards. ``fused`` is planar ``[K, m]``; with
+    ``vranks=V``, ``m = V * n`` and the stack is per-vrank ``[V, n]`` over
+    LOCAL column indices.
     """
-    if fused.ndim == 3:
-        states = jax.vmap(init_state)(fused)
-        return states
-    alive = fused[:, -1] > 0.5
-    # dead slots first, ascending slot order
-    free_stack = jnp.argsort(
-        jnp.where(alive, jnp.int32(1), jnp.int32(0)), stable=True
-    ).astype(jnp.int32)
-    n_free = jnp.sum((~alive).astype(jnp.int32))
+    alive = fused[-1, :] > 0.5
+    if vranks > 1:
+        alive = alive.reshape(vranks, -1)
+
+    def one(a):
+        stack = jnp.argsort(
+            jnp.where(a, jnp.int32(1), jnp.int32(0)), stable=True
+        ).astype(jnp.int32)
+        return stack, jnp.sum((~a).astype(jnp.int32))
+
+    if vranks > 1:
+        free_stack, n_free = jax.vmap(one)(alive)
+    else:
+        free_stack, n_free = one(alive)
     return MigrateState(fused, free_stack, n_free)
 
 
@@ -178,7 +225,7 @@ def _segment_of(k: jax.Array, cum: jax.Array) -> jax.Array:
     per-query scan (measured 200+ ms at 5M queries; the fix bought the
     headline 52 -> 45 ms/step). Use only for cum tables that stay small
     (O(V)); for tables scaling with total rank count prefer
-    ``jnp.searchsorted(..., method="sort")``."""
+    :func:`_segment_of_auto`."""
     k = jnp.asarray(k)
     return jnp.sum(
         cum[(None,) * k.ndim + (slice(1, None),)] <= k[..., None],
@@ -187,13 +234,82 @@ def _segment_of(k: jax.Array, cum: jax.Array) -> jax.Array:
     )
 
 
-def _pack_rows(fused, order, bounds, send_counts, n_dest: int,
+def _segment_of_auto(k: jax.Array, cum: jax.Array) -> jax.Array:
+    """:func:`_segment_of`, but switching to the merge-sort ``searchsorted``
+    lowering once the cum table outgrows O(tens) entries — the
+    comparison-count does O(n_segs) work per query, which on tables that
+    scale with the total rank count (R+1, Dev*V+1) becomes O(R^2 * C) per
+    step (round-2 advisor). Identical semantics on duplicate boundaries
+    (empty segments resolve past the run of duplicates) and for
+    ``k >= cum[-1]`` (returns n_segs)."""
+    if cum.shape[0] <= 33:
+        return _segment_of(k, cum)
+    return (
+        jnp.searchsorted(cum, k, side="right", method="sort").astype(
+            jnp.int32
+        )
+        - 1
+    )
+
+
+def _cycle_rescue(pending, sends_zero, ok=None):
+    """Force one self-financed swap along each stalled rotation cycle.
+
+    The receiver-granted flow control has one liveness hole (round-2
+    verdict item 5): a pure rotation cycle of length >= 3 between
+    COMPLETELY full shards at zero free slots — pairwise swaps are zero
+    and there are no free slots to grant, so the least fixpoint of the
+    self-financing grant recursion is zero and the cycle backlogs forever.
+    This helper detects such cycles and forces exactly ONE granted row on
+    each cycle edge: every member then has one forced departure AND one
+    forced arrival, so the arrival lands in the slot the member's own
+    departure vacates — lossless with zero free slots, draining the cycle
+    at one row per member per step.
+
+    Args:
+      pending: [S, S] int32, >0 where source s still wants to send to d
+        after normal grants.
+      sends_zero: [S] bool — source granted NOTHING this step (totally
+        stalled). Only such sources participate (anything else is making
+        progress already).
+      ok: optional [S] bool budget guard; a cycle is applied only if ALL
+        its members are ok (atomicity keeps the swap self-financed — a
+        partially applied cycle would give some member an arrival with no
+        departure).
+
+    Returns [S, S] int32 in {0, 1}: the forced extra grants. Cycles are
+    found in the functional graph v -> first pending destination of v,
+    restricted to stalled sources, via log-squared boolean closure of the
+    [S, S] adjacency — O(S^2 log S) elementwise work on tiny matrices.
+    """
+    S = pending.shape[0]
+    has = jnp.any(pending > 0, axis=1) & sends_zero
+    succ = jnp.argmax(pending > 0, axis=1)
+    A = jnp.where(
+        has[:, None], jax.nn.one_hot(succ, S, dtype=jnp.float32), 0.0
+    )
+    clo = A + jnp.eye(S, dtype=jnp.float32)
+    for _ in range(max(1, (max(S, 2) - 1).bit_length())):
+        clo = jnp.minimum(clo @ clo, 1.0)
+    # v is on a cycle iff a path v -> succ(v) ->* v exists
+    on_cycle = jnp.sum(A * clo.T, axis=1) > 0
+    if ok is not None:
+        # mutual reachability = the member set of v's cycle (functional
+        # graphs have only cycle SCCs); drop cycles with any !ok member
+        mutual = (clo * clo.T) > 0
+        cycle_bad = jnp.any(mutual & ~ok[None, :], axis=1)
+        on_cycle = on_cycle & ~cycle_bad
+    return (A * on_cycle[:, None]).astype(jnp.int32)
+
+
+def _pack_cols(fused, order, bounds, send_counts, n_dest: int,
                capacity: int):
-    """Gather the first ``send_counts[d]`` sorted rows of each destination
-    segment into a ``[n_dest * C, K]`` send pool (zero in invalid slots).
-    Returns ``(send, gather_idx)``; ``gather_idx[j]`` is the resident row
-    feeding send slot ``j`` (unique over valid slots)."""
-    n = fused.shape[0]
+    """Gather the first ``send_counts[d]`` sorted columns of each
+    destination segment into a ``[K, n_dest * C]`` send pool (zero in
+    invalid slots). Returns ``(send, gather_idx)``; ``gather_idx[j]`` is
+    the resident column feeding send slot ``j`` (unique over valid
+    slots)."""
+    n = fused.shape[1]
     C = capacity
     c_idx = jnp.arange(C, dtype=jnp.int32)
     flat_c = jnp.tile(c_idx, n_dest)
@@ -202,7 +318,7 @@ def _pack_rows(fused, order, bounds, send_counts, n_dest: int,
     src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
     gather_idx = order[src]  # [n_dest*C] unique over valid slots
     send = jnp.where(
-        slot_valid[:, None], jnp.take(fused, gather_idx, axis=0), 0.0
+        slot_valid[None, :], jnp.take(fused, gather_idx, axis=1), 0.0
     )
     return send, gather_idx
 
@@ -243,14 +359,14 @@ def _land_arrivals(
 ):
     """Land compacted arrivals into vacated slots, then popped holes.
 
-    ``recv`` is the flat ``[n_src * C, K]`` arrival pool (per-source slots,
-    only the first ``recv_counts[s]`` of each source's ``C`` valid);
-    ``send_counts`` / ``gather_idx`` describe this shard's own sends, whose
-    slots are being vacated. One scatter writes arrivals, hole markers and
-    the alive column together. Returns
+    ``recv`` is the planar ``[K, n_src * C]`` arrival pool (per-source
+    slots, only the first ``recv_counts[s]`` of each source's ``C``
+    valid); ``send_counts`` / ``gather_idx`` describe this shard's own
+    sends, whose slots are being vacated. One scatter writes arrivals,
+    hole markers and the alive row together. Returns
     ``(fused, free_stack, n_free, n_in, dropped_recv)``.
     """
-    n = fused.shape[0]
+    n = fused.shape[1]
     C = capacity
     n_dest = send_counts.shape[0]
     n_src = recv_counts.shape[0]
@@ -265,16 +381,16 @@ def _land_arrivals(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv_counts)]
     )
     k_idx = jnp.arange(P, dtype=jnp.int32)
-    d_of_k = _segment_of(k_idx, cum_send)
+    d_of_k = _segment_of_auto(k_idx, cum_send)
     vacated = gather_idx[
         jnp.clip(d_of_k * C + (k_idx - cum_send[d_of_k]), 0, n_dest * C - 1)
     ]  # first n_sent entries: vacated slot ids
-    s_of_k = _segment_of(k_idx, cum_recv)
+    s_of_k = _segment_of_auto(k_idx, cum_recv)
     arrivals = jnp.take(
         recv,
         jnp.clip(s_of_k * C + (k_idx - cum_recv[s_of_k]), 0, n_src * C - 1),
-        axis=0,
-    )  # first n_in rows: real arrivals (alive column already 1)
+        axis=1,
+    )  # first n_in columns: real arrivals (alive row already 1)
 
     # Write plan for slot j in [P]:
     #   j < min(n_in, n_sent): arrival j -> vacated[j]
@@ -293,9 +409,9 @@ def _land_arrivals(
             jnp.where((k_idx >= n_in) & (k_idx < n_sent), vacated, n),
         ),
     )
-    rows = jnp.where((k_idx < n_in)[:, None], arrivals, 0.0)
+    cols = jnp.where((k_idx < n_in)[None, :], arrivals, 0.0)
     # THE scatter: payload + alive flag + hole markers in one pass.
-    fused = fused.at[target].set(rows, mode="drop")
+    fused = fused.at[:, target].set(cols, mode="drop")
 
     # Free-stack update: net excess departures (n_sent - n_in when
     # positive) were written as holes at vacated[n_in : n_sent]: push them.
@@ -307,27 +423,34 @@ def _land_arrivals(
 
 
 def shard_migrate_fused_fn(
-    domain: Domain, grid: ProcessGrid, capacity: int, ndim: int = None
+    domain: Domain, grid: ProcessGrid, capacity: int, ndim: int = None,
+    cycle_rescue: bool = True,
 ):
-    """Per-shard migration on fused state (runs under ``shard_map``).
+    """Per-shard migration on planar fused state (runs under ``shard_map``).
 
     Signature of the returned fn:
       ``MigrateState -> (MigrateState, MigrateStats)``
-    where ``state.fused`` is ``[n, K]`` with columns ``0:ndim`` the position
-    (default ``domain.ndim``) and the last column the alive flag. Rows with
+    where ``state.fused`` is ``[K, n]`` with rows ``0:ndim`` the position
+    (default ``domain.ndim``) and the last row the alive flag. Columns with
     alive 0 are holes whose contents are unspecified.
+
+    ``cycle_rescue`` (default on, auto-disabled above 128 ranks) drains
+    full-shard rotation cycles via :func:`_cycle_rescue`: one extra
+    all_gather of an [R] pending vector per step, then a forced
+    self-financed swap along each detected cycle.
     """
     R = grid.nranks
     axes = grid.axis_names
     C = capacity
     D = domain.ndim if ndim is None else ndim
+    rescue = cycle_rescue and R <= 128
 
     def fn(state: MigrateState):
         fused, free_stack, n_free = state
-        K = fused.shape[1]
+        K = fused.shape[0]
         me = lax.axis_index(axes).astype(jnp.int32)
-        alive = fused[:, -1] > 0.5
-        dest = binning.rank_of_position(fused[:, :D], domain, grid)
+        alive = fused[-1, :] > 0.5
+        dest = binning.rank_of_position_planar(fused[:D, :], domain, grid)
         leaving = alive & (dest != me)
         # Sentinel R: holes and staying residents sort to the tail.
         dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
@@ -355,25 +478,44 @@ def shard_migrate_fused_fn(
             grants, axes, split_axis=0, concat_axis=0, tiled=True
         )
         send_counts = jnp.minimum(desired, grants_back)
-        backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
         # actual arrivals == my grants: grants <= recv_desired by
         # construction (swap and resid are both bounded by it), and each
         # sender sends exactly what I granted it
         recv_counts = grants
 
-        send, gather_idx = _pack_rows(
+        if rescue:
+            # drain full-shard rotation cycles: gather everyone's pending
+            # vector, find cycles in the first-pending-destination graph
+            # among totally-stalled shards, and force one granted swap
+            # per cycle edge. Safe without guards here: a stalled sender
+            # has an all-zero send row (so +1 <= C), and my grant to a
+            # stalled pred was 0 (so its recv slot +1 <= C); the forced
+            # arrival lands in the forced departure's vacated slot.
+            pend_all = lax.all_gather(
+                desired - send_counts, axes
+            ).reshape(R, R)
+            sent_tot = lax.all_gather(
+                jnp.sum(send_counts), axes
+            ).reshape(R)
+            F = _cycle_rescue(pend_all, sent_tot == 0)
+            send_counts = send_counts + F[me]
+            recv_counts = recv_counts + F[:, me]
+        backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
+
+        send, gather_idx = _pack_cols(
             fused, order, bounds, send_counts, R, C
         )
         recv = lax.all_to_all(
-            send.reshape(R, C, K), axes, split_axis=0, concat_axis=0,
-            tiled=True,
-        ).reshape(R * C, K)
+            send.reshape(K, R, C).transpose(1, 0, 2), axes,
+            split_axis=0, concat_axis=0, tiled=True,
+        )  # [R, K, C]
+        recv = recv.transpose(1, 0, 2).reshape(K, R * C)
 
         fused, free_stack, n_free, n_in, dropped_recv = _land_arrivals(
             fused, free_stack, n_free, recv, recv_counts, send_counts,
             gather_idx, C,
         )
-        population = jnp.sum((fused[:, -1] > 0.5).astype(jnp.int32))
+        population = jnp.sum((fused[-1, :] > 0.5).astype(jnp.int32))
         stats = MigrateStats(
             sent=jnp.sum(send_counts).astype(jnp.int32)[None],
             received=n_in[None],
@@ -414,7 +556,7 @@ def _plan_rows(seg_starts, seg_counts, order, length: int):
     )
     j = jnp.arange(length, dtype=jnp.int32)
     seg = jnp.clip(
-        _segment_of(j, cum),
+        _segment_of_auto(j, cum),
         0,
         seg_counts.shape[0] - 1,
     )
@@ -429,26 +571,30 @@ def shard_migrate_vranks_fn(
     capacity: int,
     ndim: int = None,
     local_budget: int = None,
+    pallas_scatter: bool = None,
+    cycle_rescue: bool = True,
 ):
-    """Migration over a ``dev_grid * vgrid`` process grid, vranks vmapped.
+    """Migration over a ``dev_grid * vgrid`` process grid, planar layout.
 
     The full Cartesian grid has shape ``dev_grid.shape * vgrid.shape``
     (elementwise): device cell ``i // v`` and vrank cell ``i % v`` per axis.
-    Each device owns ``V = vgrid.nranks`` subdomain slabs.
+    Each device owns ``V = vgrid.nranks`` subdomain slabs, side by side on
+    the lane axis of one planar ``[K, V * n]`` matrix (vrank ``v`` owns
+    columns ``[v * n, (v + 1) * n)``).
 
     Two-tier exchange (the TPU answer to MPI ranks on fewer nodes):
 
     * **On-device vrank->vrank traffic never touches a padded collective
       layout.** Migrants are routed compactly: one stable sort groups them,
       [V, V] count matrices allocate arrivals, and a single gather + single
-      scatter sized to ``local_budget`` rows move exactly the migrants (the
-      round-1 design paid gather+scatter over the full ``R*C`` padded
-      layout — 85 ns/row over mostly-empty slots dominated the step).
+      scatter sized to ``local_budget`` columns move exactly the migrants
+      (the round-1 design paid gather+scatter over the full ``R*C`` padded
+      layout — ~80 ns/row over mostly-empty slots dominated the step).
       Local routing is **lossless**: senders see receiver free-slot counts
       directly (same device) and hold rows back (``backlog``) instead of
       ever dropping an arrival.
-    * **Cross-device traffic** rides a ``[Dev, V, V, C, K]``
-      ``lax.all_to_all`` over ICI, ``capacity`` rows per (source vrank,
+    * **Cross-device traffic** rides a ``[Dev, V_src, V_dst, K, C]``
+      ``lax.all_to_all`` over ICI, ``capacity`` columns per (source vrank,
       destination vrank) pair, and is **receiver-granted**: desired counts
       fly first, each destination vrank greedily grants within its free
       slots, grants fly back, and only granted rows are packed — excess
@@ -460,10 +606,12 @@ def shard_migrate_vranks_fn(
 
     Signature of the returned per-shard fn:
       ``MigrateState -> (MigrateState, MigrateStats)``
-    with ``state.fused [V, n, K]``, ``free_stack [V, n]``, ``n_free [V]``;
+    with ``state.fused [K, V * n]``, ``free_stack [V, n]``, ``n_free [V]``;
     stats entries are ``[V]`` per device (global device-major order).
     ``local_budget`` bounds on-device migrants per (vrank, step) in each
-    direction (default ``V * capacity``, matching the round-1 total);
+    direction (default ``V * capacity``, matching the round-1 total) — the
+    landing scatter's cost scales with this PLAN length, not with actual
+    migrants, so size it to a few x the expected per-step migration;
     ``capacity`` bounds cross-device migrants per (source vrank,
     destination vrank) pair.
     """
@@ -481,34 +629,36 @@ def shard_migrate_vranks_fn(
     # static plan lengths: most rows a vrank can send / receive in a step
     S_max = M + ((Dev - 1) * V * C if Dev > 1 else 0)
     P = max(M, S_max)
+    use_pallas = _resolve_pallas_scatter(pallas_scatter)
 
     def fn(state: MigrateState):
-        fused, free_stack, n_free = state  # [V, n, K], [V, n], [V]
-        n = fused.shape[1]
-        K = fused.shape[2]
-        flat = fused.reshape(V * n, K)
+        flat, free_stack, n_free = state  # [K, V*n], [V, n], [V]
+        K = flat.shape[0]
+        n = flat.shape[1] // V
         me_dev = lax.axis_index(axes).astype(jnp.int32)
         my_v = jnp.arange(V, dtype=jnp.int32)  # vrank ids on this device
 
-        def bin_one(f, v_id):
-            alive = f[:, -1] > 0.5
-            cell = binning.cell_of_position(
-                binning.wrap_periodic(f[:, :D], domain), domain, full_grid
-            )
-            vshape = jnp.asarray(vgrid.shape, jnp.int32)
-            dev_cell = cell // vshape
-            v_cell = cell % vshape
-            dest_dev = binning.rank_of_cell(dev_cell, dev_grid)
-            dest_v = binning.rank_of_cell(v_cell, vgrid)
-            staying = (dest_dev == me_dev) & (dest_v == v_id)
-            leaving = alive & ~staying
-            # device-major global destination: dev * V + vrank
-            key = jnp.where(
-                leaving, dest_dev * V + dest_v, R_total
-            ).astype(jnp.int32)
-            return key
+        # ---- binning: planar, no vmap (elementwise on [V, n] views) ---
+        alive = flat[-1, :].reshape(V, n) > 0.5
+        posw = binning.wrap_periodic_planar(flat[:D, :], domain)
+        cell = binning.cell_of_position_planar(
+            posw, domain, full_grid
+        )  # [D, V*n]
+        dest_dev = jnp.zeros((V * n,), jnp.int32)
+        dest_v = jnp.zeros((V * n,), jnp.int32)
+        for d in range(D):
+            vs = vgrid.shape[d]
+            dest_dev = dest_dev + (cell[d] // vs) * dev_grid.strides[d]
+            dest_v = dest_v + (cell[d] % vs) * vgrid.strides[d]
+        dest_dev = dest_dev.reshape(V, n)
+        dest_v = dest_v.reshape(V, n)
+        staying = (dest_dev == me_dev) & (dest_v == my_v[:, None])
+        leaving = alive & ~staying
+        # device-major global destination: dev * V + vrank
+        dest_key = jnp.where(
+            leaving, dest_dev * V + dest_v, R_total
+        ).astype(jnp.int32)  # [V, n]
 
-        dest_key = jax.vmap(bin_one)(fused, my_v)  # [V, n]
         order, counts, bounds = jax.vmap(
             lambda k: binning.sorted_dest_counts(k, R_total)
         )(dest_key)  # [V, n], [V, R_total], [V, R_total + 1]
@@ -576,8 +726,6 @@ def shard_migrate_vranks_fn(
         # each vrank's swap arrivals exactly equal its swap departures).
         # Every truncation of the increasing orbit is safe: iteration t's
         # arrivals <= n_free + sends(t-1) + remote <= n_free + actual sends.
-        # Known limit (documented): pure rotation cycles of length >= 3 at
-        # exactly zero free slots everywhere stall in backlog.
         swap = jnp.minimum(eff, eff.T).astype(jnp.int32)
         # trim so swap arrivals fit the [M] arrival plan per dst, then
         # re-symmetrize (min with transpose keeps column sums <= M and
@@ -601,13 +749,30 @@ def shard_migrate_vranks_fn(
                 jnp.int32
             )
         allowed = swap + res  # [V_src, V_dst]
+        if cycle_rescue:
+            # drain full-vrank rotation cycles on THIS device (all the
+            # tables are local — no collective needed). A cycle is only
+            # forced if every member stays within the [M] arrival/send
+            # plans (+1 row); partial application would break the
+            # self-financing pairing, so the guard is per whole cycle.
+            pending_loc = (res_eff - res).astype(jnp.int32)
+            sends_zero = (
+                jnp.sum(allowed, axis=1) + sent_remote
+            ) == 0
+            ok = (jnp.sum(allowed, axis=1) < M) & (
+                jnp.sum(allowed, axis=0) < M
+            )
+            allowed = allowed + _cycle_rescue(
+                pending_loc, sends_zero, ok
+            )
         sent_local = jnp.sum(allowed, axis=1).astype(jnp.int32)
         n_in_local = jnp.sum(allowed, axis=0).astype(jnp.int32)
 
-        # ---- remote sends: padded [Dev, V_src, V_dst, C] over ICI -----
+        # ---- remote sends: [Dev, V_src, V_dst, K, C] over ICI ---------
         if Dev > 1:
-            # build the send buffer by index arithmetic + one flat gather;
-            # global rank ids enumerate dev-major, i.e. columns 0..R_total-1
+            # build the send buffer by index arithmetic + one flat column
+            # gather; global rank ids enumerate dev-major (columns
+            # 0..R_total-1 of the count/bound tables)
             c_i = jnp.arange(C, dtype=jnp.int32)
             cnt_sg = rem_sent_full  # [V_src, R_total]
             start_sg = bounds[:, :R_total]
@@ -619,26 +784,27 @@ def shard_migrate_vranks_fn(
                 axis=1,
             ).reshape(V, Dev * V, C)
             gsrc = my_v[:, None, None] * n + row
-            send = jnp.where(
-                valid[..., None],
-                jnp.take(flat, gsrc.reshape(-1), axis=0).reshape(
-                    V, Dev * V, C, K
-                ),
-                0.0,
+            vals = jnp.take(flat, gsrc.reshape(-1), axis=1).reshape(
+                K, V, Dev, V, C
             )
-            # [V_src, Dev, V_dst, C, K] -> [Dev, V_src, V_dst, C, K]
-            send = send.reshape(V, Dev, V, C, K).transpose(1, 0, 2, 3, 4)
+            send = jnp.where(
+                valid.reshape(V, Dev, V, C)[None], vals, 0.0
+            )
+            # [K, V_src, Dev, V_dst, C] -> [Dev, V_src, V_dst, K, C]
+            send = send.transpose(2, 1, 3, 0, 4)
             recv = lax.all_to_all(
                 send, axes, split_axis=0, concat_axis=0, tiled=True
-            )
-            # per-dst pools: [V_dst, Dev_src * V_src * C, K]; arrival
+            )  # [Dev_src, V_src, V_dst, K, C]
+            # per-dst pools: [V_dst, K, Dev_src * V_src * C]; arrival
             # counts (recv_counts_rem) were derived locally in the grant
             # phase — no extra counts exchange needed
-            recv = recv.transpose(2, 0, 1, 3, 4).reshape(V, Dev * V * C, K)
+            recv = recv.transpose(2, 3, 0, 1, 4).reshape(
+                V, K, Dev * V * C
+            )
 
         n_sent = sent_local + sent_remote
 
-        # ---- vacated slots: all rows leaving each vrank ---------------
+        # ---- vacated slots: all columns leaving each vrank ------------
         # segments: V local pairs (prefix `allowed`) then, with Dev > 1,
         # R_total global ranks (remote prefix `rem_sent_full`).
         if Dev > 1:
@@ -653,10 +819,10 @@ def shard_migrate_vranks_fn(
             lambda ss, sc, o: _plan_rows(ss, sc, o, P)
         )(seg_starts, seg_counts, order)  # [V, P]
 
-        # ---- local arrivals: one gather sized to the budget -----------
+        # ---- local arrivals: one column gather sized to the budget ----
         # dst w's arrivals: sources in order, first allowed[s, w] rows of
-        # each (s -> w) segment; arrival rows are globally indexed so one
-        # flat gather serves every vrank.
+        # each (s -> w) segment; arrival columns are globally indexed so
+        # one flat gather serves every vrank.
         cumA = jnp.concatenate(
             [jnp.zeros((1, V), jnp.int32), jnp.cumsum(allowed, axis=0)]
         )  # [V_src+1, V_dst]
@@ -667,11 +833,11 @@ def shard_migrate_vranks_fn(
             s = jnp.clip(_segment_of(j, cum), 0, V - 1)
             pos = loc_starts[s, w] + (j - cum[s])
             row = order[s, jnp.clip(pos, 0, n - 1)]
-            return s * n + row  # [M] global source rows
+            return s * n + row  # [M] global source columns
 
         arr_src = jax.vmap(arr_plan)(my_v)  # [V_dst, M]
-        arr_rows = jnp.take(flat, arr_src.reshape(-1), axis=0).reshape(
-            V, M, K
+        arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
+            K, V, M
         )
 
         # ---- landing plan: one flat scatter for arrivals + holes ------
@@ -701,16 +867,19 @@ def shard_migrate_vranks_fn(
             k_idx[None, :] < (n_sent + n_pop)[:, None]
         )
         targets = jnp.where(use_pop, pops, targets)
-        # global slot ids; sentinel n -> out of range of [V*n] (dropped)
+        # global column ids; sentinel n -> out of range of [V*n] (dropped)
         gtargets = jnp.where(
             targets >= n, V * n, my_v[:, None] * n + targets
         )
-        rows_w = jnp.zeros((V, P, K), flat.dtype).at[:, :M].set(arr_rows)
-        rows_w = jnp.where(
-            (k_idx[None, :] < n_in_local[:, None])[..., None], rows_w, 0.0
+        cols_w = jnp.zeros((K, V, P), flat.dtype).at[:, :, :M].set(
+            arr_cols
+        )
+        cols_w = jnp.where(
+            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0.0
         )
         flat = _land_scatter(
-            flat, gtargets.reshape(-1), rows_w.reshape(-1, K)
+            flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
+            use_pallas,
         )
 
         # ---- free-stack update (contiguous window blend) --------------
@@ -721,53 +890,50 @@ def shard_migrate_vranks_fn(
 
         # ---- remote landing: pops only, overflow counted --------------
         if Dev > 1:
-            fused2 = flat.reshape(V, n, K)
             P_rem = Dev * V * C
             kr = jnp.arange(P_rem, dtype=jnp.int32)
 
             def land_remote(f, fs, nf, pool, rcnt):
+                # f [K, n] (one vrank's columns), pool [K, P_rem]
                 cum = jnp.concatenate(
                     [jnp.zeros((1,), jnp.int32), jnp.cumsum(rcnt)]
                 ).astype(jnp.int32)
                 nin = cum[-1]
                 # cum here has Dev*V + 1 entries (scales with the whole
-                # machine): comparison-count would do O(Dev*V) work per
-                # query, so use the merge-sort searchsorted lowering
+                # machine): use the auto helper (merge-sort searchsorted
+                # beyond O(tens) segments)
                 s = jnp.clip(
-                    jnp.searchsorted(
-                        cum, kr, side="right", method="sort"
-                    ).astype(jnp.int32)
-                    - 1,
-                    0,
-                    Dev * V - 1,
+                    _segment_of_auto(kr, cum), 0, Dev * V - 1
                 )
                 src_slot = jnp.clip(
                     s * C + (kr - cum[s]), 0, P_rem - 1
                 )
-                arrivals = jnp.take(pool, src_slot, axis=0)
+                arrivals = jnp.take(pool, src_slot, axis=1)
                 npop = jnp.minimum(nin, nf)
                 dropped = (nin - npop).astype(jnp.int32)
                 pop_i = jnp.clip(nf - 1 - kr, 0, n - 1)
                 tgt = jnp.where(kr < npop, fs[pop_i], n)
-                f = f.at[tgt].set(
-                    jnp.where((kr < nin)[:, None], arrivals, 0.0),
+                f = f.at[:, tgt].set(
+                    jnp.where((kr < nin)[None, :], arrivals, 0.0),
                     mode="drop",
                 )
                 return f, nf - npop, nin, dropped
 
-            fused2, n_free, n_in_rem, dropped_recv = jax.vmap(
-                land_remote
-            )(fused2, free_stack, n_free, recv, recv_counts_rem)
-            flat = fused2.reshape(V * n, K)
+            flat3, n_free, n_in_rem, dropped_recv = jax.vmap(
+                land_remote,
+                in_axes=(1, 0, 0, 0, 0),
+                out_axes=(1, 0, 0, 0),
+            )(flat.reshape(K, V, n), free_stack, n_free, recv,
+              recv_counts_rem)
+            flat = flat3.reshape(K, V * n)
             received = n_in_local + n_in_rem
         else:
             dropped_recv = jnp.zeros((V,), jnp.int32)
             received = n_in_local
 
-        fused = flat.reshape(V, n, K)
         backlog = (leavers - n_sent).astype(jnp.int32)
         population = jnp.sum(
-            (fused[:, :, -1] > 0.5).astype(jnp.int32), axis=1
+            (flat[-1, :].reshape(V, n) > 0.5).astype(jnp.int32), axis=1
         )
         stats = MigrateStats(
             sent=n_sent,
@@ -776,7 +942,7 @@ def shard_migrate_vranks_fn(
             backlog=backlog,
             dropped_recv=dropped_recv,
         )
-        return MigrateState(fused, free_stack, n_free), stats
+        return MigrateState(flat, free_stack, n_free), stats
 
     return fn
 

@@ -1,17 +1,18 @@
 """Knockout profiling of shard_migrate_vranks_fn: time the step truncated
-after each phase (cumulative), at bench-identical shapes on one device.
+after each phase (cumulative), at bench-identical shapes on one device —
+plus a logical-bytes column turning the attribution into a ROOFLINE
+statement (bytes touched / v5e HBM peak vs measured ms).
 
 Phase deltas attribute the full step's time to real code, not to isolated
 microbenches (which can differ from what XLA emits in context — e.g. the
 vmapped scatter microbench costs 2x the flat scatter the step uses).
 
 MAINTENANCE: ``truncated_step`` is a DELIBERATE copy of the Dev==1 slice
-of ``parallel/migrate.shard_migrate_vranks_fn`` with early exits — a
-truncating profiler cannot share the un-truncatable original. If the
-migrate step changes, re-sync this copy or the per-phase table in
-BENCH_CONFIGS.md describes a stale pipeline. Sanity check: phase 8 must
-match the FULL-step time from scripts/profile_stages.py / bench.py
-(52.5 vs 53.4 vs 52.7 ms when last synced).
+of ``parallel/migrate.shard_migrate_vranks_fn`` (PLANAR [K, V*n] layout,
+round 3) with early exits — a truncating profiler cannot share the
+un-truncatable original. If the migrate step changes, re-sync this copy
+or the per-phase table in BENCH_CONFIGS.md describes a stale pipeline.
+Sanity check: phase 8 must match the FULL-step time from bench.py.
 
 Usage: python scripts/knockout_stages.py [n_local]
 """
@@ -37,18 +38,19 @@ from mpi_grid_redistribute_tpu.utils import profiling
 GRID = (2, 2, 2)
 FILL = 0.9
 MIGRATION = 0.02
+K = 7
+# v5e HBM peak (datasheet): ~819 GB/s. Used for the roofline column.
+HBM_PEAK = 819e9
 
 
 def truncated_step(domain, vgrid, C, M, n, phase):
-    """Body of the vrank migrate step (Dev=1), cut after ``phase``."""
+    """Body of the PLANAR vrank migrate step (Dev=1), cut after ``phase``."""
     V = vgrid.nranks
     R_total = V
     P = M
 
     def fn(state):
-        fused, free_stack, n_free = state
-        K = fused.shape[2]
-        flat = fused.reshape(V * n, K)
+        flat, free_stack, n_free = state  # [K, V*n], [V, n], [V]
         my_v = jnp.arange(V, dtype=jnp.int32)
 
         def dep_out(*arrs):
@@ -56,29 +58,34 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             d = jnp.float32(0)
             for a in arrs:
                 d = d + a.ravel()[0].astype(jnp.float32) * jnp.float32(1e-38)
-            fused2 = fused.at[0, 0, 0].add(d)
-            return migrate.MigrateState(fused2, free_stack, n_free)
-
-        def bin_one(f, v_id):
-            alive = f[:, -1] > 0.5
-            cell = binning.cell_of_position(
-                binning.wrap_periodic(f[:, :3], domain), domain, vgrid
+            return migrate.MigrateState(
+                flat.at[0, 0].add(d), free_stack, n_free
             )
-            dest_v = binning.rank_of_cell(cell, vgrid)
-            staying = dest_v == v_id
-            leaving = alive & ~staying
-            return jnp.where(leaving, dest_v, R_total).astype(jnp.int32)
 
-        dest_key = jax.vmap(bin_one)(fused, my_v)
+        # ---- 1: bin (planar elementwise) --------------------------------
+        alive = flat[-1, :].reshape(V, n) > 0.5
+        cell = binning.cell_of_position_planar(
+            binning.wrap_periodic_planar(flat[:3, :], domain), domain, vgrid
+        )
+        dv = jnp.zeros((V * n,), jnp.int32)
+        for d in range(3):
+            dv = dv + (cell[d] % vgrid.shape[d]) * vgrid.strides[d]
+        dv = dv.reshape(V, n)
+        staying = dv == my_v[:, None]
+        dest_key = jnp.where(alive & ~staying, dv, R_total).astype(
+            jnp.int32
+        )
         if phase == 1:
             return dep_out(dest_key)
 
+        # ---- 2: stable key sort + counts --------------------------------
         order, counts, bounds = jax.vmap(
             lambda k: binning.sorted_dest_counts(k, R_total)
         )(dest_key)
         if phase == 2:
             return dep_out(order, counts, bounds)
 
+        # ---- 3: local allocation fixpoint (+ cycle rescue) --------------
         loc_counts = counts[:, :V]
         loc_starts = bounds[:, :V]
         rel_start = loc_starts - loc_starts[:, :1]
@@ -102,18 +109,28 @@ def truncated_step(domain, vgrid, C, M, n, phase):
                 res_eff, jnp.maximum(cap_res, 0)
             ).astype(jnp.int32)
         allowed = swap + res
+        pending_loc = (res_eff - res).astype(jnp.int32)
+        sends_zero = jnp.sum(allowed, axis=1) == 0
+        ok = (jnp.sum(allowed, axis=1) < M) & (
+            jnp.sum(allowed, axis=0) < M
+        )
+        allowed = allowed + migrate._cycle_rescue(
+            pending_loc, sends_zero, ok
+        )
         sent_local = jnp.sum(allowed, axis=1).astype(jnp.int32)
         n_in_local = jnp.sum(allowed, axis=0).astype(jnp.int32)
         n_sent = sent_local
         if phase == 3:
             return dep_out(allowed, n_sent, n_in_local)
 
+        # ---- 4: vacated-slot plan ---------------------------------------
         vacated, _tot = jax.vmap(
             lambda ss, sc, o: migrate._plan_rows(ss, sc, o, P)
         )(loc_starts, allowed, order)
         if phase == 4:
             return dep_out(vacated)
 
+        # ---- 5: arrival gather ------------------------------------------
         cumA = jnp.concatenate(
             [jnp.zeros((1, V), jnp.int32), jnp.cumsum(allowed, axis=0)]
         )
@@ -127,12 +144,13 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             return s * n + row
 
         arr_src = jax.vmap(arr_plan)(my_v)
-        arr_rows = jnp.take(flat, arr_src.reshape(-1), axis=0).reshape(
-            V, M, K
+        arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
+            K, V, M
         )
         if phase == 5:
-            return dep_out(arr_rows)
+            return dep_out(arr_cols)
 
+        # ---- 6: landing plan --------------------------------------------
         k_idx = jnp.arange(P, dtype=jnp.int32)
 
         def land_plan(vac, nin, nsent, nf):
@@ -163,25 +181,46 @@ def truncated_step(domain, vgrid, C, M, n, phase):
         if phase == 6:
             return dep_out(gtargets)
 
-        rows_w = jnp.where(
-            (k_idx[None, :] < n_in_local[:, None])[..., None], arr_rows, 0.0
+        # ---- 7: landing scatter (planar columns) ------------------------
+        cols_w = jnp.zeros((K, V, P), flat.dtype).at[:, :, :M].set(
+            arr_cols
         )
-        flat2 = flat.at[gtargets.reshape(-1)].set(
-            rows_w.reshape(-1, K), mode="drop"
+        cols_w = jnp.where(
+            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0.0
+        )
+        flat2 = flat.at[:, gtargets.reshape(-1)].set(
+            cols_w.reshape(K, V * P), mode="drop"
         )
         if phase == 7:
-            f2 = flat2.reshape(V, n, K)
-            return migrate.MigrateState(f2, free_stack, n_free)
+            return migrate.MigrateState(flat2, free_stack, n_free)
 
+        # ---- 8: free-stack update ---------------------------------------
         n_push = jnp.maximum(n_sent - n_in_local, 0)
         free_stack2, n_free2 = jax.vmap(migrate._stack_push_pop)(
             free_stack, n_free, n_pop, n_push, vacated, n_in_local
         )
-        return migrate.MigrateState(
-            flat2.reshape(V, n, K), free_stack2, n_free2
-        )
+        return migrate.MigrateState(flat2, free_stack2, n_free2)
 
     return fn
+
+
+def phase_bytes(V, n, M, migrants):
+    """Logical bytes each phase NEWLY touches (reads + writes), for the
+    roofline column. Deliberately the *minimum* traffic the phase's math
+    implies — sorts do multiple physical passes and scatters touch whole
+    (8,128) tiles per lane written, so measured/roofline >> 1 flags a
+    latency/serialization bound, not a bandwidth wall."""
+    f32 = 4
+    return {
+        1: (3 + 3 + 1 + 1) * V * n * f32,  # read pos+vel+alive, write key
+        2: 4 * V * n * f32,                # sort in/out of (key, iota)
+        3: 0,                              # [V, V] tables
+        4: 3 * V * M * f32,                # plan vectors + order gather
+        5: (K + 1) * V * M * f32 + K * V * M * f32,  # gather in+out
+        6: 4 * V * M * f32,                # plan vectors
+        7: (K + 1) * V * M * f32,          # scatter writes + targets
+        8: 2 * V * M * f32,                # stack windows
+    }
 
 
 def main():
@@ -194,11 +233,23 @@ def main():
     vgrid = ProcessGrid(GRID)
 
     rng = np.random.default_rng(0)
-    K = 7
-    fused = rng.random((V, n, K), dtype=np.float32)
-    fused[:, :, -1] = (rng.random((V, n)) < FILL).astype(np.float32)
-    state = migrate.init_state(jax.device_put(jnp.asarray(fused)))
+    fused = rng.random((K, V * n), dtype=np.float32)
+    fused[-1, :] = (rng.random((V * n,)) < FILL).astype(np.float32)
+    state = migrate.init_state(
+        jax.device_put(jnp.asarray(fused)), vranks=V
+    )
+    migrants = int(V * n * FILL * MIGRATION)
+    pb = phase_bytes(V, n, M, migrants)
 
+    print(
+        f"shapes: V={V} n={n} M={M} (plan rows/vrank), "
+        f"~{migrants} migrants/step expected", file=sys.stderr,
+    )
+    print(
+        "| phase (cumulative) | ms | delta | logical MB | roofline ms "
+        "| x-roofline |", file=sys.stderr,
+    )
+    print("|---|---|---|---|---|---|", file=sys.stderr)
     prev = 0.0
     for phase in range(1, 9):
         step = truncated_step(domain, vgrid, C, M, n, phase)
@@ -211,9 +262,9 @@ def main():
                 def body(st, _):
                     # drift so dest_key changes each step
                     f = st.fused
-                    p = f[..., :3] + f[..., 3:6] * jnp.float32(1e-4)
-                    p = binning.wrap_periodic(p, domain)
-                    f = jnp.concatenate([p, f[..., 3:]], axis=-1)
+                    p = f[:3, :] + f[3:6, :] * jnp.float32(1e-4)
+                    p = binning.wrap_periodic_planar(p, domain)
+                    f = jnp.concatenate([p, f[3:, :]], axis=0)
                     st2 = step(st._replace(fused=f))
                     return st2, ()
 
@@ -225,9 +276,14 @@ def main():
         per, _, _ = profiling.scan_time_per_step(
             make_loop, tuple(state), s1=4, s2=16
         )
+        mb = pb[phase] / 1e6
+        roof = pb[phase] / HBM_PEAK * 1e3
+        delta = (per - prev) * 1e3
+        ratio = delta / roof if roof > 0 else float("inf")
         print(
-            f"phase {phase}: {per*1e3:7.2f} ms  (delta "
-            f"{(per - prev)*1e3:+7.2f} ms)"
+            f"| {phase} | {per*1e3:7.2f} | {delta:+7.2f} | {mb:8.1f} "
+            f"| {roof:6.2f} | {ratio:6.1f} |",
+            file=sys.stderr,
         )
         prev = per
 

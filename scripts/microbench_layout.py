@@ -1,0 +1,219 @@
+"""Microbench: [K, n] (transposed, lane-major) vs [n, K] (row-major) layouts.
+
+Drives the round-3 redesign (VERDICT round-2 items 1-2): the migrate scan
+carry must become ``[K, n]`` so no narrow-minor rank-2 buffer materializes
+(T(8,128) tiling pads ``[n, 7]`` 18x at carry boundaries — 32 GB at 64M
+rows).  The open question is what the pack gather and landing scatter cost
+in that layout:
+
+  1. column gather ``x[:, idx]``     on [8, n]  vs row gather    on [n, 8]
+  2. column scatter ``x.at[:, t]``   on [8, n]  vs row scatter   on [n, 8]
+  3. sorted-target column scatter (the write plan can be sorted cheaply)
+  4. contiguous tail landing: dynamic_update_slice [8, P] into [8, n]
+  5. 1-D scatter of P elements into [n] (alive-kill cost floor)
+  6. transpose [n, 8] -> [8, n] at size (materialization cost)
+
+Usage: python scripts/microbench_layout.py  (from /root/repo)
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.utils import profiling
+
+N = 2**23  # resident columns/rows
+P = 2**18  # rows moved per step
+K = 8
+
+
+def timed(name, make_loop, args, s1=4, s2=16):
+    per_step, _, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    print(f"  {name:46s} {per_step*1e3:8.3f} ms  {per_step*1e9/P:7.1f} ns/row",
+          file=sys.stderr, flush=True)
+    return per_step * 1e3
+
+
+def _idx(sorted_idx=False, n=N, p=P):
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, n, size=(p,), dtype=np.int32)
+    if sorted_idx:
+        idx = np.sort(idx)
+    return jax.device_put(jnp.asarray(idx))
+
+
+def _chain(i, dep):
+    # thread a dependency through a float-underflow product so XLA cannot
+    # constant-fold the loop body away (memory: int *0 folds)
+    return (i + (dep * 1e-38).astype(jnp.int32)) % N
+
+
+def bench_row_gather():
+    rng = np.random.default_rng(1)
+    arr = jax.device_put(jnp.asarray(rng.random((N, K), dtype=np.float32)))
+    idx = _idx()
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx):
+            def body(carry, _):
+                a, i = carry
+                out = jnp.take(a, i, axis=0)
+                (a, i, out) = lax.optimization_barrier((a, i, out))
+                i = _chain(i, out[0, 0])
+                return (a, i), ()
+            return lax.scan(body, (arr, idx), None, length=S)[0]
+        return loop
+    return make_loop, (arr, idx)
+
+
+def bench_col_gather(sorted_idx=False):
+    rng = np.random.default_rng(1)
+    arr = jax.device_put(jnp.asarray(rng.random((K, N), dtype=np.float32)))
+    idx = _idx(sorted_idx)
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx):
+            def body(carry, _):
+                a, i = carry
+                out = jnp.take(a, i, axis=1)
+                (a, i, out) = lax.optimization_barrier((a, i, out))
+                i = _chain(i, out[0, 0])
+                return (a, i), ()
+            return lax.scan(body, (arr, idx), None, length=S)[0]
+        return loop
+    return make_loop, (arr, idx)
+
+
+def bench_row_scatter(sorted_idx=False):
+    rng = np.random.default_rng(2)
+    arr = jax.device_put(jnp.asarray(rng.random((N, K), dtype=np.float32)))
+    rows = jax.device_put(jnp.asarray(rng.random((P, K), dtype=np.float32)))
+    idx = _idx(sorted_idx)
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx, rows):
+            def body(carry, _):
+                a, i = carry
+                a = a.at[i].set(rows, mode="drop")
+                (a, i) = lax.optimization_barrier((a, i))
+                i = _chain(i, a[0, 0])
+                return (a, i), ()
+            return lax.scan(body, (arr, idx), None, length=S)[0]
+        return loop
+    return make_loop, (arr, idx, rows)
+
+
+def bench_col_scatter(sorted_idx=False):
+    rng = np.random.default_rng(2)
+    arr = jax.device_put(jnp.asarray(rng.random((K, N), dtype=np.float32)))
+    cols = jax.device_put(jnp.asarray(rng.random((K, P), dtype=np.float32)))
+    idx = _idx(sorted_idx)
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx, cols):
+            def body(carry, _):
+                a, i = carry
+                a = a.at[:, i].set(cols, mode="drop")
+                (a, i) = lax.optimization_barrier((a, i))
+                i = _chain(i, a[0, 0])
+                return (a, i), ()
+            return lax.scan(body, (arr, idx, cols)[:2], None, length=S)[0]
+        return loop
+    return make_loop, (arr, idx, cols)
+
+
+def bench_tail_dus():
+    rng = np.random.default_rng(3)
+    arr = jax.device_put(jnp.asarray(rng.random((K, N), dtype=np.float32)))
+    cols = jax.device_put(jnp.asarray(rng.random((K, P), dtype=np.float32)))
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, cols):
+            def body(carry, _):
+                a, off = carry
+                a = lax.dynamic_update_slice(a, cols, (0, off))
+                (a,) = lax.optimization_barrier((a,))
+                off = (off + 1 + (a[0, 0] * 1e-38).astype(jnp.int32)) % (N - P)
+                return (a, off), ()
+            return lax.scan(body, (arr, jnp.int32(0)), None, length=S)[0]
+        return loop
+    return make_loop, (arr, cols)
+
+
+def bench_scatter_1d(sorted_idx=False):
+    rng = np.random.default_rng(4)
+    arr = jax.device_put(jnp.asarray(rng.random((N,), dtype=np.float32)))
+    vals = jax.device_put(jnp.asarray(rng.random((P,), dtype=np.float32)))
+    idx = _idx(sorted_idx)
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr, idx, vals):
+            def body(carry, _):
+                a, i = carry
+                a = a.at[i].set(vals, mode="drop")
+                (a, i) = lax.optimization_barrier((a, i))
+                i = _chain(i, a[0])
+                return (a, i), ()
+            return lax.scan(body, (arr, idx, vals)[:2], None, length=S)[0]
+        return loop
+    return make_loop, (arr, idx, vals)
+
+
+def bench_transpose():
+    rng = np.random.default_rng(5)
+    arr = jax.device_put(jnp.asarray(rng.random((N, K), dtype=np.float32)))
+
+    def make_loop(S):
+        @jax.jit
+        def loop(arr):
+            def body(a, _):
+                t = a.T
+                (t,) = lax.optimization_barrier((t,))
+                a = t.T
+                (a,) = lax.optimization_barrier((a,))
+                return a, ()
+            return lax.scan(body, arr, None, length=S)[0]
+        return loop
+    return make_loop, (arr,)
+
+
+def main():
+    print(f"n={N} ({N/1e6:.1f}M), P={P} ({P/1e3:.0f}k), K={K}",
+          file=sys.stderr)
+    ml, args = bench_row_gather()
+    timed("row gather  [n,8] random", ml, args)
+    ml, args = bench_col_gather()
+    timed("col gather  [8,n] random", ml, args)
+    ml, args = bench_col_gather(sorted_idx=True)
+    timed("col gather  [8,n] SORTED", ml, args)
+    ml, args = bench_row_scatter()
+    timed("row scatter [n,8] random", ml, args)
+    ml, args = bench_row_scatter(sorted_idx=True)
+    timed("row scatter [n,8] SORTED", ml, args)
+    ml, args = bench_col_scatter()
+    timed("col scatter [8,n] random", ml, args)
+    ml, args = bench_col_scatter(sorted_idx=True)
+    timed("col scatter [8,n] SORTED", ml, args)
+    ml, args = bench_tail_dus()
+    timed("tail DUS    [8,P] into [8,n]", ml, args)
+    ml, args = bench_scatter_1d()
+    timed("1-D scatter [n] random", ml, args)
+    ml, args = bench_scatter_1d(sorted_idx=True)
+    timed("1-D scatter [n] SORTED", ml, args)
+    ml, args = bench_transpose()
+    timed("transpose   [n,8]<->[8,n] x2 (per pair)", ml, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,16 @@
 """Per-stage device-time attribution for the headline migrate step.
 
-Times each pipeline stage of the vrank migrate step in isolation at
-bench-identical shapes (V vranks of n rows, K fused columns, per-pair
-capacity C), using the same scan-length-differencing as bench.py so the
+Times each pipeline stage of the PLANAR vrank migrate step in isolation at
+bench-identical shapes (V vranks of n columns, K fused rows, on-device
+budget M), using the same scan-length-differencing as bench.py so the
 ~100 ms tunnel round-trip cancels. Each stage's scan carries a data
 dependency through the timed op so XLA cannot hoist or DCE it.
 
-Usage:  python scripts/profile_stages.py [n_local] [capacity]
+In-context attribution (the sum here can differ from the real step —
+isolated microbenches measured 2x off for the vmapped scatter) lives in
+scripts/knockout_stages.py; this script is the per-op sanity check.
 
-Output: a markdown table of ms/step per stage; paste into README (VERDICT
-round-1 item 1: publish the stage table explaining where the step time
-goes).
+Usage:  python scripts/profile_stages.py [n_local] [capacity]
 """
 
 from __future__ import annotations
@@ -47,36 +47,27 @@ def main():
         if len(sys.argv) > 2
         else max(64, math.ceil(FILL * n * MIGRATION / distinct * 1.3))
     )
-    # compact on-device routing budget (bench.py's local_budget): the
-    # gather/scatter plans are sized to M migrant rows per vrank, not to
-    # the R*C padded collective layout
     M_budget = max(256, math.ceil(FILL * n * MIGRATION * 1.3))
     domain = Domain(0.0, 1.0, periodic=True)
     vgrid = ProcessGrid(GRID)
     dev_grid = ProcessGrid((1, 1, 1))
 
     rng = np.random.default_rng(0)
-    fused = rng.random((V, n, K), dtype=np.float32)
-    fused[:, :, -1] = (rng.random((V, n)) < FILL).astype(np.float32)
+    # planar fused state: [K, V*n], alive = last row
+    fused = rng.random((K, V * n), dtype=np.float32)
+    fused[-1, :] = (rng.random((V * n,)) < FILL).astype(np.float32)
     fused = jax.device_put(jnp.asarray(fused))
-    # a plausible dest_key distribution: mostly sentinel (stay), ~2% spread
-    # over the 3 distinct neighbors
     key_np = np.full((V, n), R_TOTAL, np.int32)
     m = int(n * FILL * MIGRATION)
     for v in range(V):
         idx = rng.choice(n, size=m, replace=False)
-        key_np[v, idx] = rng.choice([1, 2, 4], size=m)  # face neighbors of 0
+        key_np[v, idx] = rng.choice([1, 2, 4], size=m)
     dest_key = jax.device_put(jnp.asarray(key_np))
     gather_idx = jax.device_put(
-        jnp.asarray(
-            rng.integers(0, n, size=(V, M_budget), dtype=np.int32)
-        )
+        jnp.asarray(rng.integers(0, n, size=(V, M_budget), dtype=np.int32))
     )
-    target = gather_idx
-    rows = jax.device_put(
-        jnp.asarray(
-            rng.random((V, M_budget, K), dtype=np.float32)
-        )
+    cols = jax.device_put(
+        jnp.asarray(rng.random((K, V * M_budget), dtype=np.float32))
     )
 
     stages = {}
@@ -86,37 +77,33 @@ def main():
             make_loop, args, s1=s1, s2=s2
         )
         stages[name] = per_step * 1e3
-        print(f"  {name:30s} {per_step*1e3:8.2f} ms", file=sys.stderr)
+        print(f"  {name:34s} {per_step*1e3:8.2f} ms", file=sys.stderr)
 
-    # --- 1. elementwise: drift + wrap + bin -> dest key -----------------
     full_shape = tuple(d * v for d, v in zip(dev_grid.shape, vgrid.shape))
     full_grid = ProcessGrid(full_shape)
 
-    def bin_one(f, v_id):
-        cell = binning.cell_of_position(
-            binning.wrap_periodic(f[:, :3], domain), domain, full_grid
-        )
-        vshape = jnp.asarray(vgrid.shape, jnp.int32)
-        dest_v = binning.rank_of_cell(cell % vshape, vgrid)
-        staying = dest_v == v_id
-        alive = f[:, -1] > 0.5
-        return jnp.where(
-            alive & ~staying, dest_v, R_TOTAL
-        ).astype(jnp.int32)
-
+    # --- 1. elementwise: drift + wrap + bin -> dest key -----------------
     def make_bin_loop(S):
         @jax.jit
         def loop(fused):
             def body(f, _):
-                p = f[..., :3] + f[..., 3:6] * jnp.float32(1e-4)
-                p = binning.wrap_periodic(p, domain)
-                f = jnp.concatenate([p, f[..., 3:]], axis=-1)
-                key = jax.vmap(bin_one)(f, jnp.arange(V, dtype=jnp.int32))
-                # dependency: fold key stats back into carry
-                # float-underflow dependency: tiny*sum underflows to 0
-                # at runtime but cannot be constant-folded like `* 0`
-                dep = key.sum(axis=1).astype(jnp.float32) * jnp.float32(1e-38)
-                f = f.at[:, 0, 0].add(dep)
+                p = f[:3, :] + f[3:6, :] * jnp.float32(1e-4)
+                p = binning.wrap_periodic_planar(p, domain)
+                f = jnp.concatenate([p, f[3:, :]], axis=0)
+                alive = f[-1, :].reshape(V, n) > 0.5
+                cell = binning.cell_of_position_planar(
+                    f[:3, :], domain, full_grid
+                )
+                dv = jnp.zeros((V * n,), jnp.int32)
+                for d in range(3):
+                    dv = dv + (
+                        cell[d] % vgrid.shape[d]
+                    ) * vgrid.strides[d]
+                dv = dv.reshape(V, n)
+                staying = dv == jnp.arange(V, dtype=jnp.int32)[:, None]
+                key = jnp.where(alive & ~staying, dv, R_TOTAL)
+                dep = key.sum(axis=1).astype(jnp.float32).sum() * 1e-38
+                f = f.at[0, 0].add(dep)
                 return f, ()
 
             f, _ = lax.scan(body, fused, None, length=S)
@@ -124,7 +111,7 @@ def main():
 
         return loop
 
-    timed("drift+wrap+bin (elementwise)", make_bin_loop, fused)
+    timed("drift+wrap+bin (planar)", make_bin_loop, fused)
 
     # --- 2. stable key sort + counts ------------------------------------
     def make_sort_loop(S):
@@ -148,17 +135,18 @@ def main():
 
     timed("stable sort + searchsorted", make_sort_loop, dest_key)
 
-    # --- 3. pack gather: [V, R*C] rows from [V, n, K] --------------------
+    # --- 3. arrival gather: [K, V*M] columns from [K, V*n] ---------------
     def make_gather_loop(S):
         @jax.jit
         def loop(fused, idx):
             def body(carry, _):
                 f, i = carry
-                send = jax.vmap(
-                    lambda ff, ii: jnp.take(ff, ii, axis=0)
-                )(f, i)
-                dep = (send[:, :1, 0] * jnp.float32(1e-38)).astype(jnp.int32)
-                i = (i + dep) % n
+                gi = (
+                    jnp.arange(V, dtype=jnp.int32)[:, None] * n + i
+                ).reshape(-1)
+                send = jnp.take(f, gi, axis=1)
+                dep = (send[0, :1] * jnp.float32(1e-38)).astype(jnp.int32)
+                i = (i + dep[None, :]) % n
                 return (f, i), ()
 
             (f, i), _ = lax.scan(body, (fused, idx), None, length=S)
@@ -166,28 +154,21 @@ def main():
 
         return loop
 
-    timed(f"arrival gather ({V}x{M_budget} rows)", make_gather_loop, fused,
+    timed(f"arrival gather ({V}x{M_budget} cols)", make_gather_loop, fused,
           gather_idx)
 
-    # --- 4. landing scatter: flat [V*M] rows into [V*n, K] ---------------
-    # FLAT, as the real step does it: the vmapped per-vrank form measures
-    # ~2x slower than what XLA emits for the flat scatter (measured; see
-    # scripts/knockout_stages.py for in-context attribution)
+    # --- 4. landing scatter: [K, V*M] columns into [K, V*n] --------------
     def make_scatter_loop(S):
         @jax.jit
-        def loop(fused, tgt, rows):
+        def loop(fused, tgt, cols):
             def body(carry, _):
                 f, t = carry
-                flat = f.reshape(V * n, K)
                 gt = (
                     jnp.arange(V, dtype=jnp.int32)[:, None] * n + t
                 ).reshape(-1)
-                flat = flat.at[gt].set(
-                    rows.reshape(-1, K), mode="drop"
-                )
-                f = flat.reshape(V, n, K)
-                dep = (f[:, :1, 0] * jnp.float32(1e-38)).astype(jnp.int32)
-                t = (t + dep) % n
+                f = f.at[:, gt].set(cols, mode="drop")
+                dep = (f[0, :1] * jnp.float32(1e-38)).astype(jnp.int32)
+                t = (t + dep[None, :]) % n
                 return (f, t), ()
 
             (f, t), _ = lax.scan(body, (fused, tgt), None, length=S)
@@ -195,11 +176,11 @@ def main():
 
         return loop
 
-    timed(f"landing scatter (flat {V}x{M_budget} rows)", make_scatter_loop,
-          fused, target, rows)
+    timed(f"landing scatter ({V}x{M_budget} cols)", make_scatter_loop,
+          fused, gather_idx, cols)
 
     # --- 5. full migrate step (reference) --------------------------------
-    from mpi_grid_redistribute_tpu.parallel import migrate, mesh as mesh_lib
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
     from mpi_grid_redistribute_tpu.models import nbody
 
     cfg = nbody.DriftConfig(
@@ -207,13 +188,16 @@ def main():
         local_budget=M_budget,
     )
     mesh = mesh_lib.make_mesh(dev_grid, devices=jax.devices()[:1])
-    pos = np.asarray(fused[0][:, :3]).copy()
     pos_all = rng.random((V * n, 3), dtype=np.float32)
     vel_all = rng.random((V * n, 3), dtype=np.float32) * 1e-4
     alive_all = rng.random((V * n,)) < FILL
     args = (
-        jax.device_put(jnp.asarray(pos_all)),
-        jax.device_put(jnp.asarray(vel_all)),
+        jax.device_put(
+            jnp.asarray(nbody.rows_to_planar(pos_all, mesh.size))
+        ),
+        jax.device_put(
+            jnp.asarray(nbody.rows_to_planar(vel_all, mesh.size))
+        ),
         jax.device_put(jnp.asarray(alive_all)),
     )
     timed(

@@ -326,7 +326,7 @@ def test_migrate_loop_deposit_each_step(rng, _devices):
     alive = rng.random(R * n_local) > 0.2
     loop = nbody.make_migrate_loop(cfg, mesh, 3, deposit_each_step=True)
     p, v, a, st, rho = jax.tree.map(np.asarray, loop(pos, vel, alive))
-    p = p.reshape(-1, 3)
+    p = nbody.planar_to_rows(p, 3, mesh.size)
     survivors = int(a.sum())
     np.testing.assert_allclose(rho.sum(), survivors, rtol=1e-4)
     # equals a standalone deposit of the final state

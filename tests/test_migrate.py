@@ -17,15 +17,31 @@ from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
 
 
 def _rows_set(pos, vel, mask):
+    """EXACT bitcast-int row sets: the migrate path only ever moves rows
+    (gather/all_to_all/scatter on the fused matrix), so payload bits must
+    survive verbatim — a sub-1e-5 corruption in the bitcast fuse/scatter
+    path is a bug, not noise (round-2 verdict item 9)."""
     rows = np.concatenate([pos[mask], vel[mask]], axis=1)
-    return {tuple(r) for r in np.round(rows, 5).tolist()}
+    return {tuple(r) for r in rows.view(np.uint32).tolist()}
 
 
 def _np_drift_reference(domain, grid, pos, vel, alive, dt, n_steps):
-    """Plain NumPy drift loop: returns per-shard row sets after n_steps."""
+    """Reference drift loop: returns per-shard row sets after n_steps.
+
+    The drift arithmetic runs through the same XLA-compiled elementwise
+    kernel as the device step (one jit, unsharded) so float32 rounding —
+    including any multiply-add contraction — is bit-identical; the
+    redistribution bookkeeping stays plain NumPy. The migrate path itself
+    only moves rows, so the final sets must match the device EXACTLY."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _drift(p, v):
+        return binning.wrap_periodic(p + v * jnp.asarray(dt, p.dtype), domain)
+
     pos, vel, alive = pos.copy(), vel.copy(), alive.copy()
     for _ in range(n_steps):
-        pos[alive] = (pos[alive] + vel[alive] * dt) % 1.0
+        pos[alive] = np.asarray(_drift(pos[alive], vel[alive]))
     dest = binning.rank_of_position(pos, domain, grid, xp=np)
     shard_sets = []
     for r in range(grid.nranks):
@@ -62,7 +78,8 @@ def test_migrate_matches_reference_sets(shape, rng, _devices):
     pos_f, vel_f, alive_f, stats = jax.tree.map(
         np.asarray, loop(pos, vel, alive)
     )
-    pos_f, vel_f = pos_f.reshape(-1, 3), vel_f.reshape(-1, 3)
+    pos_f = nbody.planar_to_rows(pos_f, 3, mesh.size)
+    vel_f = nbody.planar_to_rows(vel_f, 3, mesh.size)
 
     assert stats.backlog.sum() == 0
     assert stats.dropped_recv.sum() == 0
@@ -217,7 +234,8 @@ def test_migrate_vranks_full_swap_is_lossless(rng, _devices):
     pos_f, vel_f, alive_f, stats = jax.tree.map(
         np.asarray, loop(pos, vel, alive)
     )
-    pos_f, vel_f = pos_f.reshape(-1, 3), vel_f.reshape(-1, 3)
+    pos_f = nbody.planar_to_rows(pos_f, 3, mesh.size)
+    vel_f = nbody.planar_to_rows(vel_f, 3, mesh.size)
     assert stats.dropped_recv.sum() == 0
     assert stats.backlog.sum() == 0
     assert stats.sent.sum() == n
@@ -277,7 +295,8 @@ def test_migrate_vranks_matches_reference_sets(dev_shape, v_shape, rng, _devices
     pos_f, vel_f, alive_f, stats = jax.tree.map(
         np.asarray, loop(pos, vel, alive)
     )
-    pos_f, vel_f = pos_f.reshape(-1, 3), vel_f.reshape(-1, 3)
+    pos_f = nbody.planar_to_rows(pos_f, 3, mesh.size)
+    vel_f = nbody.planar_to_rows(vel_f, 3, mesh.size)
 
     assert stats.backlog.sum() == 0
     assert stats.dropped_recv.sum() == 0
@@ -337,6 +356,83 @@ def test_vranks_cross_device_receive_is_lossless(rng, _devices):
     # only `free` movers could land; the rest are backlogged
     assert stats.sent.sum() == free
     assert stats.backlog.sum() == n_local - free
+
+
+def test_migrate_vranks_full_rotation_cycle_drains(rng, _devices):
+    """A pure rotation cycle of length 3 between COMPLETELY full vranks
+    at zero free slots — the round-2 documented stall — must now drain
+    via the forced cycle swaps (one row per member per step), ending at
+    zero backlog with every row on its owner (round-2 verdict item 5)."""
+    dev_grid = ProcessGrid((1, 1, 1))
+    vgrid = ProcessGrid((3, 1, 1))
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = 8
+    n = 3 * n_local
+    mesh = mesh_lib.make_mesh(dev_grid, devices=jax.devices()[:1])
+
+    # vrank v owns x in [v/3, (v+1)/3); place EVERY row of vrank v inside
+    # vrank (v+1)%3's slab -> 0 -> 1 -> 2 -> 0 rotation, zero holes.
+    pos = rng.random((n, 3), dtype=np.float32)
+    for v in range(3):
+        nxt = (v + 1) % 3
+        pos[v * n_local : (v + 1) * n_local, 0] = (
+            (nxt + 0.5) / 3.0
+        )
+    vel = np.zeros((n, 3), dtype=np.float32)
+    alive = np.ones(n, dtype=bool)
+
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=0.0, capacity=n_local,
+        n_local=n_local,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, n_local, vgrid=vgrid)
+    pos_f, vel_f, alive_f, stats = jax.tree.map(
+        np.asarray, loop(pos, vel, alive)
+    )
+    pos_f = nbody.planar_to_rows(pos_f, 3, mesh.size)
+    assert stats.dropped_recv.sum() == 0
+    assert alive_f.sum() == n
+    # one forced swap per member per step: backlog shrinks monotonically
+    per_step = stats.backlog.sum(axis=1)
+    assert per_step[0] == n - 3  # 3 rows moved on the first step
+    assert per_step[-1] == 0, f"cycle did not drain: {per_step}"
+    # every row ended on its owning vrank slab
+    full = ProcessGrid((3, 1, 1))
+    dest_f = binning.rank_of_position(pos_f, domain, full, xp=np)
+    assert (dest_f == np.repeat(np.arange(3), n_local)).all()
+
+
+def test_migrate_flat_full_rotation_cycle_drains(rng, _devices):
+    """Same 3-cycle stall on the flat multi-device path: the all_gather
+    cycle rescue must drain it."""
+    grid = ProcessGrid((3, 1, 1))
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = 6
+    n = 3 * n_local
+    mesh = mesh_lib.make_mesh(grid, devices=jax.devices()[:3])
+
+    pos = rng.random((n, 3), dtype=np.float32)
+    for v in range(3):
+        nxt = (v + 1) % 3
+        pos[v * n_local : (v + 1) * n_local, 0] = (nxt + 0.5) / 3.0
+    vel = np.zeros((n, 3), dtype=np.float32)
+    alive = np.ones(n, dtype=bool)
+
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=grid, dt=0.0, capacity=n_local,
+        n_local=n_local,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, n_local)
+    pos_f, vel_f, alive_f, stats = jax.tree.map(
+        np.asarray, loop(pos, vel, alive)
+    )
+    pos_f = nbody.planar_to_rows(pos_f, 3, mesh.size)
+    assert stats.dropped_recv.sum() == 0
+    assert alive_f.sum() == n
+    per_step = stats.backlog.sum(axis=1)
+    assert per_step[-1] == 0, f"cycle did not drain: {per_step}"
+    dest_f = binning.rank_of_position(pos_f, domain, grid, xp=np)
+    assert (dest_f == np.repeat(np.arange(3), n_local)).all()
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
