@@ -76,14 +76,27 @@ class GridRedistribute:
           rebuild at the next power-of-two capacity bucket, and re-run the
           same step on the unchanged inputs; the grown capacities stick on
           the instance, so later calls recompile only on further bucket
-          crossings. Loss-free, but syncs stats to the host every call.
-        * ``'raise'`` — raise :class:`RuntimeError` on any drop (also a
-          host sync). The opt-out of growth that still never loses
+          crossings. The overflow check is SYNCHRONOUS (one host fetch per
+          call) only while calibrating: after two consecutive clean
+          checks the instance switches to DEFERRED checking — every
+          ``check_every``-th call starts an async device-to-host copy of
+          the drop counters and the previous deferred copy (long since
+          materialized) is read without blocking dispatch. Steady-state
+          loops therefore issue no blocking stats sync. A late-detected
+          drop cannot be healed retroactively (its result was already
+          consumed), so it GROWS capacity for subsequent calls and raises
+          :class:`RuntimeError` naming the lossy window — never silent.
+          Call :meth:`flush_overflow_checks` at loop end to resolve the
+          final pending window.
+        * ``'raise'`` — raise :class:`RuntimeError` on any drop (a host
+          sync every call). The opt-out of growth that still never loses
           silently.
         * ``'ignore'`` — return with drop counters surfaced in
-          ``result.stats`` (the round-1 behavior). The only mode that
-          keeps dispatch fully asynchronous; callers own the check, e.g.
+          ``result.stats`` (the round-1 behavior). Fully asynchronous,
+          zero bookkeeping; callers own the check, e.g.
           ``utils.stats.check_no_loss``.
+      check_every: cadence (in calls) of the deferred overflow check once
+        ``'grow'`` has calibrated (default 16).
     """
 
     def __init__(
@@ -100,6 +113,7 @@ class GridRedistribute:
         capacity_factor: float = 2.0,
         out_capacity: Optional[int] = None,
         on_overflow: str = "grow",
+        check_every: int = 16,
     ):
         self.domain = _as_domain(domain, lo, hi, periodic)
         if grid is None:
@@ -120,6 +134,19 @@ class GridRedistribute:
                 f"got {on_overflow!r}"
             )
         self.on_overflow = on_overflow
+        if int(check_every) < 1:
+            raise ValueError(f"check_every must be >= 1, got {check_every}")
+        self.check_every = int(check_every)
+        # deferred-check state for 'grow' (see class docstring): number of
+        # consecutive clean synchronous checks, calls since the last
+        # deferred check was scheduled, the pending async-copied counters,
+        # and an instrumentation counter of blocking stat fetches (tests
+        # assert the steady state issues none per call).
+        self._clean_checks = 0
+        self._calls_since_check = 0
+        self._pending_check = None  # (counters dict, cap, out_cap, call#)
+        self._call_index = 0
+        self._blocking_fetches = 0
         self.capacity = capacity
         self.capacity_factor = float(capacity_factor)
         self.out_capacity = out_capacity
@@ -274,16 +301,29 @@ class GridRedistribute:
         positions, fields, n_local, count = self._check_inputs(
             positions, fields, count
         )
+        self._call_index += 1
         max_attempts = 5
         for _ in range(max_attempts):
             cap, out_cap = self._capacities(n_local)
             result = self._run_once(positions, fields, count, cap, out_cap)
             if self.on_overflow == "ignore":
                 return result  # async preserved: no host sync on stats
+            if (
+                self.on_overflow == "grow"
+                and self._clean_checks >= 2
+                and self.backend == "jax"
+            ):
+                # calibrated: deferred checking keeps dispatch async
+                self._deferred_check(result, n_local, cap, out_cap)
+                return result
+            self._blocking_fetches += 1
             dropped_send = int(np.asarray(result.stats.dropped_send).sum())
             dropped_recv = int(np.asarray(result.stats.dropped_recv).sum())
             if not dropped_send and not dropped_recv:
+                if self.on_overflow == "grow":
+                    self._clean_checks += 1
                 return result
+            self._clean_checks = 0
             if self.on_overflow == "raise":
                 raise RuntimeError(
                     f"particle loss detected: dropped_send={dropped_send}, "
@@ -292,23 +332,17 @@ class GridRedistribute:
                 )
             # grow: size the rebuild from the measured need, bucketed to
             # powers of two so recompiles track bucket crossings only
-            grew = False
-            if dropped_send:
-                needed = int(np.asarray(result.stats.needed_capacity).max())
-                new_cap = min(_next_pow2(needed), n_local)
-                if new_cap > cap:
-                    self.capacity, grew = new_cap, True
-            if dropped_recv:
-                needed_out = int(
-                    (
-                        np.asarray(result.count)
-                        + np.asarray(result.stats.dropped_recv)
-                    ).max()
-                )
-                new_out = min(_next_pow2(needed_out), self.nranks * n_local)
-                if new_out > out_cap:
-                    self.out_capacity, grew = new_out, True
-            if not grew:
+            needed = int(np.asarray(result.stats.needed_capacity).max())
+            needed_out = int(
+                (
+                    np.asarray(result.count)
+                    + np.asarray(result.stats.dropped_recv)
+                ).max()
+            )
+            if not self._grow(
+                dropped_send, dropped_recv, needed, needed_out, n_local,
+                cap, out_cap,
+            ):
                 raise RuntimeError(
                     f"overflow not resolvable by growth (capacity {cap}, "
                     f"out_capacity {out_cap} already at their maxima): "
@@ -317,6 +351,83 @@ class GridRedistribute:
         raise RuntimeError(
             f"capacity growth did not converge in {max_attempts} attempts"
         )
+
+    def _grow(
+        self, dropped_send, dropped_recv, needed, needed_out, n_local,
+        cap, out_cap,
+    ) -> bool:
+        """Raise the instance capacities from measured need; True if grown."""
+        grew = False
+        if dropped_send:
+            new_cap = min(_next_pow2(needed), n_local)
+            if new_cap > cap:
+                self.capacity, grew = new_cap, True
+        if dropped_recv:
+            new_out = min(_next_pow2(needed_out), self.nranks * n_local)
+            if new_out > out_cap:
+                self.out_capacity, grew = new_out, True
+        return grew
+
+    def _deferred_check(self, result, n_local, cap, out_cap) -> None:
+        """Every ``check_every``-th call: resolve the previous deferred
+        counter copy (device compute for it finished many calls ago, so
+        the read does not serialize dispatch) and schedule a new one."""
+        self._calls_since_check += 1
+        if self._calls_since_check < self.check_every:
+            return
+        self._calls_since_check = 0
+        self._resolve_pending()
+        counters = {
+            "dropped_send": result.stats.dropped_send,
+            "dropped_recv": result.stats.dropped_recv,
+            "needed_capacity": result.stats.needed_capacity,
+            "count": result.count,
+        }
+        for v in counters.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        self._pending_check = (
+            counters, cap, out_cap, n_local, self._call_index
+        )
+
+    def _resolve_pending(self) -> None:
+        if self._pending_check is None:
+            return
+        counters, cap, out_cap, n_local, call_idx = self._pending_check
+        self._pending_check = None
+        dropped_send = int(np.asarray(counters["dropped_send"]).sum())
+        dropped_recv = int(np.asarray(counters["dropped_recv"]).sum())
+        if not dropped_send and not dropped_recv:
+            return
+        # A drop this late cannot be healed (results already consumed):
+        # grow for subsequent runs, then fail loudly — never silently.
+        needed = int(np.asarray(counters["needed_capacity"]).max())
+        needed_out = int(
+            (
+                np.asarray(counters["count"])
+                + np.asarray(counters["dropped_recv"])
+            ).max()
+        )
+        self._grow(
+            dropped_send, dropped_recv, needed, needed_out, n_local,
+            cap, out_cap,
+        )
+        self._clean_checks = 0
+        raise RuntimeError(
+            f"deferred overflow check: call {call_idx} dropped "
+            f"{dropped_send} (send) / {dropped_recv} (recv) particles; "
+            f"capacities have been grown for subsequent calls, but results "
+            f"since that call are lossy — restart from the last checkpoint "
+            f"or rerun. Use a smaller check_every (or "
+            f"on_overflow='ignore' + your own per-step check) to narrow "
+            f"the window."
+        )
+
+    def flush_overflow_checks(self) -> None:
+        """Resolve any pending deferred overflow check (blocking). Call at
+        loop end under ``on_overflow='grow'`` so the final window is
+        verified; raises like the in-loop check on detected loss."""
+        self._resolve_pending()
 
     __call__ = redistribute
 

@@ -450,7 +450,23 @@ def shard_migrate_fused_fn(
         K = fused.shape[0]
         me = lax.axis_index(axes).astype(jnp.int32)
         alive = fused[-1, :] > 0.5
-        dest = binning.rank_of_position_planar(fused[:D, :], domain, grid)
+        # per-axis fused elementwise binning (no stacked [D, n]
+        # intermediates; see the vranks path for the measurement)
+        dest = jnp.zeros(fused.shape[1:], jnp.int32)
+        for d in range(D):
+            p = fused[d, :]
+            lo = jnp.asarray(domain.lo[d], p.dtype)
+            ext = jnp.asarray(domain.extent[d], p.dtype)
+            if domain.periodic[d]:
+                p = lo + jnp.remainder(p - lo, ext)
+                p = jnp.where(p >= lo + ext, lo, p)
+            inv_w = jnp.asarray(grid.shape[d], p.dtype) / ext
+            cell_d = jnp.clip(
+                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                0,
+                grid.shape[d] - 1,
+            )
+            dest = dest + cell_d * jnp.int32(grid.strides[d])
         leaving = alive & (dest != me)
         # Sentinel R: holes and staying residents sort to the tail.
         dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
@@ -638,18 +654,29 @@ def shard_migrate_vranks_fn(
         me_dev = lax.axis_index(axes).astype(jnp.int32)
         my_v = jnp.arange(V, dtype=jnp.int32)  # vrank ids on this device
 
-        # ---- binning: planar, no vmap (elementwise on [V, n] views) ---
+        # ---- binning: per-axis fused elementwise chains (no stacked
+        # [D, m] intermediates — each axis's wrap+floor+clip+accumulate
+        # fuses into one pass over [V*n]; the stacked helper variant
+        # measured 22x its bandwidth roofline in the knockout profile)
         alive = flat[-1, :].reshape(V, n) > 0.5
-        posw = binning.wrap_periodic_planar(flat[:D, :], domain)
-        cell = binning.cell_of_position_planar(
-            posw, domain, full_grid
-        )  # [D, V*n]
         dest_dev = jnp.zeros((V * n,), jnp.int32)
         dest_v = jnp.zeros((V * n,), jnp.int32)
         for d in range(D):
+            p = flat[d, :]
+            lo = jnp.asarray(domain.lo[d], p.dtype)
+            ext = jnp.asarray(domain.extent[d], p.dtype)
+            if domain.periodic[d]:
+                p = lo + jnp.remainder(p - lo, ext)
+                p = jnp.where(p >= lo + ext, lo, p)
+            inv_w = jnp.asarray(full_grid.shape[d], p.dtype) / ext
+            cell_d = jnp.clip(
+                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                0,
+                full_grid.shape[d] - 1,
+            )
             vs = vgrid.shape[d]
-            dest_dev = dest_dev + (cell[d] // vs) * dev_grid.strides[d]
-            dest_v = dest_v + (cell[d] % vs) * vgrid.strides[d]
+            dest_dev = dest_dev + (cell_d // vs) * dev_grid.strides[d]
+            dest_v = dest_v + (cell_d % vs) * vgrid.strides[d]
         dest_dev = dest_dev.reshape(V, n)
         dest_v = dest_v.reshape(V, n)
         staying = (dest_dev == me_dev) & (dest_v == my_v[:, None])
@@ -659,6 +686,12 @@ def shard_migrate_vranks_fn(
             leaving, dest_dev * V + dest_v, R_total
         ).astype(jnp.int32)  # [V, n]
 
+        # NOTE a flat composite-key sort (one [V*n] sort replacing the V
+        # vmapped sorts) was measured and REJECTED: the vmapped
+        # sorted_dest_counts is 5.7 ms at 8x1M while the flat composite
+        # sort alone is 9.8 ms, and the boundary lookup it then needs —
+        # searchsorted(method="sort"), 72 queries over 8.4M keys — costs
+        # a pathological ~97 ms on this stack (scripts/microbench_sort.py)
         order, counts, bounds = jax.vmap(
             lambda k: binning.sorted_dest_counts(k, R_total)
         )(dest_key)  # [V, n], [V, R_total], [V, R_total + 1]

@@ -62,14 +62,23 @@ def truncated_step(domain, vgrid, C, M, n, phase):
                 flat.at[0, 0].add(d), free_stack, n_free
             )
 
-        # ---- 1: bin (planar elementwise) --------------------------------
+        # ---- 1: bin (per-axis fused elementwise, matches migrate.py) ----
         alive = flat[-1, :].reshape(V, n) > 0.5
-        cell = binning.cell_of_position_planar(
-            binning.wrap_periodic_planar(flat[:3, :], domain), domain, vgrid
-        )
         dv = jnp.zeros((V * n,), jnp.int32)
         for d in range(3):
-            dv = dv + (cell[d] % vgrid.shape[d]) * vgrid.strides[d]
+            p = flat[d, :]
+            lo = jnp.asarray(domain.lo[d], p.dtype)
+            ext = jnp.asarray(domain.extent[d], p.dtype)
+            if domain.periodic[d]:
+                p = lo + jnp.remainder(p - lo, ext)
+                p = jnp.where(p >= lo + ext, lo, p)
+            inv_w = jnp.asarray(vgrid.shape[d], p.dtype) / ext
+            cell_d = jnp.clip(
+                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                0,
+                vgrid.shape[d] - 1,
+            )
+            dv = dv + (cell_d % vgrid.shape[d]) * vgrid.strides[d]
         dv = dv.reshape(V, n)
         staying = dv == my_v[:, None]
         dest_key = jnp.where(alive & ~staying, dv, R_total).astype(

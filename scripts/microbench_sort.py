@@ -1,0 +1,131 @@
+"""Isolate the round-3 sort-path regression: flat composite-key sort vs
+vmapped per-vrank sort, and the boundary-searchsorted variants.
+
+Usage: python scripts/microbench_sort.py
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.utils import profiling
+from mpi_grid_redistribute_tpu.ops import binning
+
+V, n, R = 8, 2**20, 8
+
+
+def timed(name, make_loop, args, s1=4, s2=12):
+    per, _, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    print(f"  {name:52s} {per*1e3:8.2f} ms", file=sys.stderr, flush=True)
+
+
+def keys():
+    rng = np.random.default_rng(0)
+    k = np.full((V, n), R, np.int32)
+    m = int(n * 0.018)
+    for v in range(V):
+        idx = rng.choice(n, size=m, replace=False)
+        k[v, idx] = rng.choice([1, 2, 4], size=m)
+    return jax.device_put(jnp.asarray(k))
+
+
+def dep(k, x):
+    return (k + (x.ravel()[:1].astype(jnp.float32) * 1e-38).astype(k.dtype)).astype(jnp.int32)
+
+
+def make_vmapped(S):
+    @jax.jit
+    def loop(key):
+        def body(k, _):
+            order, counts, bounds = jax.vmap(
+                lambda kk: binning.sorted_dest_counts(kk, R)
+            )(k)
+            return dep(k, order + counts[:, :1] + bounds[:, :1]), ()
+        return lax.scan(body, keys_dev, None, length=S)[0]
+    return loop
+
+
+def make_flat(S):
+    my_v = jnp.arange(V, dtype=jnp.int32)
+    stride = R + 1
+
+    @jax.jit
+    def loop(key):
+        def body(k, _):
+            comp = (my_v[:, None] * stride + k).reshape(V * n)
+            iota = jnp.arange(V * n, dtype=jnp.int32)
+            ks, order_flat = lax.sort((comp, iota), num_keys=1,
+                                      is_stable=True)
+            qry = (my_v[:, None] * stride
+                   + jnp.arange(R + 1, dtype=jnp.int32)[None, :]).reshape(-1)
+            b = jnp.searchsorted(ks, qry, side="left",
+                                 method="sort").astype(jnp.int32)
+            return dep(k, order_flat + b[:1]), ()
+        return lax.scan(body, keys_dev, None, length=S)[0]
+    return loop
+
+
+def make_flat_sort_only(S):
+    my_v = jnp.arange(V, dtype=jnp.int32)
+    stride = R + 1
+
+    @jax.jit
+    def loop(key):
+        def body(k, _):
+            comp = (my_v[:, None] * stride + k).reshape(V * n)
+            iota = jnp.arange(V * n, dtype=jnp.int32)
+            ks, order_flat = lax.sort((comp, iota), num_keys=1,
+                                      is_stable=True)
+            return dep(k, order_flat + ks[:1]), ()
+        return lax.scan(body, keys_dev, None, length=S)[0]
+    return loop
+
+
+def make_flat_countbounds(S):
+    my_v = jnp.arange(V, dtype=jnp.int32)
+    stride = R + 1
+
+    @jax.jit
+    def loop(key):
+        def body(k, _):
+            comp = (my_v[:, None] * stride + k).reshape(V * n)
+            iota = jnp.arange(V * n, dtype=jnp.int32)
+            ks, order_flat = lax.sort((comp, iota), num_keys=1,
+                                      is_stable=True)
+            # counts via one-pass histogram over the 72 composite values:
+            # comparison-count on the SORTED keys is monotone -> per
+            # boundary b: #keys < b = sum(ks < b) is O(72 * V*n)… instead
+            # bincount-free: segment ids are tiny; use sum over equality
+            cnt = jnp.sum(
+                (comp[None, :] == jnp.arange(V * stride, dtype=jnp.int32)[:, None]),
+                axis=1, dtype=jnp.int32,
+            )
+            bounds = jnp.cumsum(cnt)
+            return dep(k, order_flat + bounds[:1]), ()
+        return lax.scan(body, keys_dev, None, length=S)[0]
+    return loop
+
+
+def make_vmapped_sort_only(S):
+    @jax.jit
+    def loop(key):
+        def body(k, _):
+            iota = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (V, n))
+            ks, order = lax.sort((k, iota), dimension=1, num_keys=1,
+                                 is_stable=True)
+            return dep(k, order + ks[:, :1]), ()
+        return lax.scan(body, keys_dev, None, length=S)[0]
+    return loop
+
+
+keys_dev = keys()
+
+timed("vmapped sorted_dest_counts (round-2 path)", make_vmapped, (keys_dev,))
+timed("vmapped sort only (no searchsorted)", make_vmapped_sort_only, (keys_dev,))
+timed("flat composite sort only", make_flat_sort_only, (keys_dev,))
+timed("flat sort + searchsorted(method=sort) 72 qrys", make_flat, (keys_dev,))
+timed("flat sort + equality-histogram bounds", make_flat_countbounds, (keys_dev,))

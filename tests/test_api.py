@@ -202,3 +202,63 @@ def test_near_cubic_shape():
     assert mesh_lib.near_cubic_shape(16) == (4, 2, 2)
     assert mesh_lib.near_cubic_shape(1) == (1, 1, 1)
     assert mesh_lib.near_cubic_shape(12, ndim=2) == (4, 3)
+
+
+def test_grow_deferred_check_is_async_in_steady_state(rng):
+    """VERDICT round-2 item 8: after calibration (two clean synchronous
+    checks), 'grow' must issue NO blocking stats fetch per call — only
+    the every-check_every deferred resolution of an already-materialized
+    counter copy."""
+    pos, ids, vel = _inputs(rng, n_local=64)
+    rd = GridRedistribute(DOMAIN, (2, 2, 2), capacity_factor=16.0,
+                          on_overflow="grow", check_every=4)
+    # calibration: synchronous checks until two consecutive are clean
+    # (the first call may grow once, costing an extra fetch)
+    rd.redistribute(pos, vel, ids)
+    rd.redistribute(pos, vel, ids)
+    rd.redistribute(pos, vel, ids)
+    assert rd._clean_checks >= 2
+    calibrated_fetches = rd._blocking_fetches
+    for _ in range(8):
+        rd.redistribute(pos, vel, ids)
+    # steady state: zero additional blocking fetches in 8 calls
+    assert rd._blocking_fetches == calibrated_fetches
+    # deferred checks were scheduled (every 4th call) and stayed clean
+    rd.flush_overflow_checks()  # resolves the last window; must not raise
+
+
+def test_grow_deferred_check_detects_late_overflow(rng):
+    """A drop that happens after calibration is detected at the next
+    deferred checkpoint: capacities grow for subsequent calls and the
+    check raises loudly (results in the window are lossy — retroactive
+    healing is impossible; never silent)."""
+    R, n_local = 8, 64
+    pos, ids, vel = _inputs(rng, R=R, n_local=n_local)
+    # placed state: every row already on its owner -> zero sends -> the
+    # tiny explicit capacity stays clean during calibration
+    from mpi_grid_redistribute_tpu.ops import binning
+    grid = ProcessGrid((2, 2, 2))
+    dest = binning.rank_of_position(pos, DOMAIN, grid, xp=np)
+    order = np.argsort(dest, kind="stable")
+    # exactly n_local rows per rank is not guaranteed; use counts layout
+    counts = np.bincount(dest, minlength=R)
+    cap_rows = int(counts.max())
+    placed = np.zeros((R * cap_rows, 3), np.float32)
+    cnt = np.zeros((R,), np.int32)
+    for r in range(R):
+        rows = pos[dest == r]
+        placed[r * cap_rows : r * cap_rows + len(rows)] = rows
+        cnt[r] = len(rows)
+    rd = GridRedistribute(DOMAIN, (2, 2, 2), capacity=1,
+                          on_overflow="grow", check_every=1)
+    rd.redistribute(placed, count=cnt)
+    rd.redistribute(placed, count=cnt)
+    assert rd._clean_checks == 2
+    # clustered call: everything heads to one rank; capacity=1 drops
+    clustered = placed.copy()
+    clustered[:, :] = 0.1  # all rows into rank 0's cell
+    rd.redistribute(clustered, count=cnt)  # schedules pending counters
+    old_cap = rd.capacity
+    with pytest.raises(RuntimeError, match="deferred overflow check"):
+        rd.redistribute(clustered, count=cnt)
+    assert rd.capacity > old_cap  # grown for subsequent calls
