@@ -188,6 +188,8 @@ def time_cpu_oracle(n_total: int, migration: float, n_steps: int = 5,
 def main() -> None:
     import jax
 
+    from mpi_grid_redistribute_tpu.utils import profiling
+
     on_tpu = jax.devices()[0].platform not in ("cpu",)
     n_local = int(
         os.environ.get("BENCH_N_LOCAL", 2**20 if on_tpu else 2**14)
@@ -234,6 +236,17 @@ def main() -> None:
                 "exchange_bytes_per_step": round(xbytes, 1),
                 "exchange_bytes_per_sec": round(xbytes / per_step, 1),
                 "exchange_domain": xdomain,
+                # Utilization = bytes/s vs the domain's peak (HBM 819 GB/s
+                # on one chip; 4x45 GB/s summed ICI links per chip on >=8).
+                # Low by design at the default 2% migration rate: the
+                # exchange moves only migrant payload, so the step is
+                # compute-bound (see knockout roofline, BENCH_CONFIGS.md).
+                "exchange_bw_util": round(
+                    profiling.exchange_bw_util(
+                        xbytes / per_step, xdomain, n_chips
+                    ),
+                    6,
+                ),
             }
         )
     )

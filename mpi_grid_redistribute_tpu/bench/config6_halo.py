@@ -1,0 +1,107 @@
+"""Config 6: halo / ghost exchange timed on-chip (SURVEY.md C8, §3.4).
+
+Measures the 2-passes-per-axis ghost exchange (`parallel/halo.py`) as
+virtual ranks on one chip — the same vrank-twin methodology as configs
+1–5: identical per-slab math to the shard_map engine (shared helpers),
+with each ppermute realized as the grid-axis roll it performs on the
+wire. Capacities are the derived defaults (`halo.default_capacities`);
+the JSON reports the measured ghost fraction against the analytic
+halo-volume expectation so auto-sizing is validated at bench scale, plus
+per-ghost cost (ns/ghost) for cross-round tracking.
+
+Note the halo path carries row-major ``[V, n, 3]`` buffers (positions
+are its *payload*, not just routing keys), so it pays the T(8,128)
+minor-axis padding the planar migrate engine avoids; sizes here are
+chosen to fit comfortably. A planar halo is the obvious next step if
+halo time ever dominates a workload (BENCH_CONFIGS.md).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
+from mpi_grid_redistribute_tpu.bench import common
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def run(n_local: int = None, width_frac: float = 0.1) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    scale = float(os.environ.get("BENCH_SCALE", 1.0))
+    n_local = n_local or max(1 << 12, int(scale * (1 << 18)))
+    grid_shape = (2, 2, 2)
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    w = width_frac * min(grid.cell_widths(domain))
+
+    fill = 1.0
+    rng = np.random.default_rng(0)
+    pos, _, _ = common.uniform_state(grid_shape, n_local, fill, rng)
+    count = np.full((R,), n_local, np.int32)
+    pc, gc = halo_lib.default_capacities(domain, grid, w, n_local)
+
+    pos_v = jax.device_put(
+        jnp.asarray(pos.reshape(R, n_local, 3))
+    )
+    count_v = jax.device_put(jnp.asarray(count))
+
+    def make_loop(S: int):
+        fn = halo_lib.vrank_halo_fn(domain, grid, w, pc, gc)
+
+        @jax.jit
+        def loop(pos, count):
+            def body(carry, _):
+                p, c = carry
+                gpos, gcount, overflow = fn(p, c)
+                # fold a ghost statistic back into the carry so the scan
+                # cannot be dead-code-eliminated between iterations
+                p = p + 0.0 * gpos[:, :1, :].sum(axis=1, keepdims=True)
+                return (p, c), (gcount, overflow)
+            (p, c), (gcounts, overflows) = jax.lax.scan(
+                body, (pos, count), None, length=S
+            )
+            return p, gcounts, overflows
+
+        return loop
+
+    per_step, _, long_out = profiling.scan_time_per_step(
+        make_loop, (pos_v, count_v), s1=4, s2=16
+    )
+    gcounts = np.asarray(long_out[1])
+    overflow = int(np.asarray(long_out[2]).sum())
+    ghosts = int(gcounts[-1].sum())
+    total = R * n_local
+    f = w / min(grid.cell_widths(domain))
+    expect_frac = (1.0 + 2.0 * f) ** 3 - 1.0
+
+    res = {
+        "metric": "config6_halo_ms_per_exchange",
+        "value": round(per_step * 1e3, 3),
+        "unit": "ms",
+        "n_total": total,
+        "halo_width": w,
+        "ghosts_per_exchange": ghosts,
+        "ghost_frac_measured": round(ghosts / total, 4),
+        "ghost_frac_expected_uniform": round(expect_frac, 4),
+        "ns_per_ghost": round(per_step / max(ghosts, 1) * 1e9, 1),
+        "pass_capacity": pc,
+        "ghost_capacity": gc,
+        "overflow": overflow,
+    }
+    common.log(
+        f"config6: halo {per_step*1e3:.2f} ms/exchange, {ghosts} ghosts "
+        f"({ghosts/total:.1%} of {total}; uniform expectation "
+        f"{expect_frac:.1%}), overflow {overflow}"
+    )
+    return res
+
+
+if __name__ == "__main__":
+    common.emit(run())

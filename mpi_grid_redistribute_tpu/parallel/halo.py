@@ -19,10 +19,29 @@ axis exchange on the device mesh:
 
 ``halo_width`` must not exceed the per-axis subdomain width: one hop per
 axis is exactly the single-neighbor-shell guarantee.
+
+Capacities default to sizes derived from the halo-volume fraction
+(:func:`default_capacities`): under near-uniform density the expected shell
+population is ``n_local * (prod(1 + 2*w_a/cell_w_a) - 1)``, padded by a
+headroom factor. Clustered inputs can exceed any static bound — overflow is
+counted per shard and returned (never silent), mirroring the redistribute
+path's measured-capacity contract.
+
+Two interchangeable engines share the per-slab math (same mask, same
+stable pack, same append), differing only in the communication primitive:
+
+  * :func:`build_halo_exchange` — ``shard_map`` over a device mesh,
+    ``lax.ppermute`` on the wire (ICI);
+  * :func:`build_halo_vranks` — V virtual ranks on ONE device, vmapped
+    slabs, the ppermute becomes the grid-axis roll it would perform on the
+    wire. Lets a single chip run — and honestly benchmark — the halo at
+    any R, exactly like the redistribute's vrank twin.
 """
 
 from __future__ import annotations
 
+import functools
+import math
 from typing import NamedTuple, Tuple
 
 import jax
@@ -56,6 +75,107 @@ def _as_per_axis(width, ndim: int) -> Tuple[float, ...]:
     return t
 
 
+def _validate_widths(domain: Domain, grid: ProcessGrid, halo_width):
+    ndim = domain.ndim
+    widths = _as_per_axis(halo_width, ndim)
+    cell_w = grid.cell_widths(domain)
+    for a in range(ndim):
+        if widths[a] < 0:
+            raise ValueError(f"halo_width[{a}] must be >= 0")
+        if widths[a] > cell_w[a]:
+            raise ValueError(
+                f"halo_width[{a}]={widths[a]} exceeds subdomain width "
+                f"{cell_w[a]}; multi-hop halos are not supported"
+            )
+    return widths, cell_w
+
+
+def default_capacities(
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    n_local: int,
+    headroom: float = 2.0,
+) -> Tuple[int, int]:
+    """Derived ``(pass_capacity, ghost_capacity)`` for near-uniform density.
+
+    Per axis the face-shell fraction is ``f_a = w_a / cell_w_a`` per
+    direction; a pass along axis ``a`` selects from own rows plus ghosts
+    received on earlier axes, so its expected send is
+    ``n_local * f_a * prod_{b<a}(1 + 2 f_b)`` and the total expected shell
+    population is ``n_local * (prod_a(1 + 2 f_a) - 1)``. Both are padded by
+    ``headroom`` (default 2x) and rounded up to a lane-friendly multiple of
+    8. Clustered inputs can exceed these bounds — the exchange counts and
+    returns ``overflow`` per shard; on a nonzero overflow, rebuild with
+    bigger capacities (same contract as the redistribute's measured
+    ``needed_capacity``).
+    """
+    widths, cell_w = _validate_widths(domain, grid, halo_width)
+    if n_local <= 0:
+        raise ValueError(f"n_local must be positive, got {n_local}")
+    f = [w / cw for w, cw in zip(widths, cell_w)]
+    pass_cap = 0.0
+    grown = 1.0
+    for a in range(domain.ndim):
+        pass_cap = max(pass_cap, n_local * f[a] * grown)
+        grown *= 1.0 + 2.0 * f[a]
+    ghost_cap = n_local * (grown - 1.0)
+
+    def pad(x: float) -> int:
+        return max(8, int(math.ceil(x * headroom / 8.0)) * 8)
+
+    return pad(pass_cap), pad(ghost_cap)
+
+
+def _select_for_pass(cand, cand_valid, a, dirn, lo_a, hi_a, w, at_edge,
+                     periodic, extent_a, H):
+    """Per-slab, per-(axis, direction) outgoing selection.
+
+    Picks the candidate rows within ``w`` of the face, stable-packs the
+    first ``H`` into a padded send buffer, applies the periodic frame
+    shift, and returns ``(send_tree, send_cnt, overflow_inc)``. Shared by
+    the shard_map and vrank engines so their semantics cannot drift.
+    """
+    pos = cand[0]
+    coord = pos[:, a]
+    if dirn == 1:
+        mask = cand_valid & (coord >= hi_a - w)
+    else:
+        mask = cand_valid & (coord < lo_a + w)
+    if not periodic:
+        mask = mask & jnp.logical_not(at_edge)
+    cnt = jnp.sum(mask.astype(jnp.int32))
+    overflow_inc = jnp.maximum(cnt - H, 0)
+    send_cnt = jnp.minimum(cnt, H)
+    order = _stable_order(~mask)
+    take = _take_rows(order, H)
+    slot_valid = jnp.arange(H, dtype=jnp.int32) < send_cnt
+    send = jax.tree.map(
+        lambda arr: _mask_rows(jnp.take(arr, take, axis=0), slot_valid),
+        cand,
+    )
+    # Periodic wrap: shift the ghost coordinate into the receiver's frame
+    # (+1 across the hi wrap -> subtract extent).
+    shift = jnp.where(
+        at_edge & periodic,
+        -jnp.asarray(dirn, pos.dtype) * extent_a,
+        jnp.asarray(0, pos.dtype),
+    )
+    send_pos = send[0].at[:, a].add(jnp.where(slot_valid, shift, 0))
+    return (send_pos,) + tuple(send[1:]), send_cnt, overflow_inc
+
+
+def _append_recv(ghost, gcount, overflow, recv, recv_cnt, H, G):
+    """Append a received padded slab to the per-slab ghost buffers."""
+    app_valid = jnp.arange(H, dtype=jnp.int32) < recv_cnt
+    overflow = overflow + jnp.maximum(gcount + recv_cnt - G, 0)
+    idx = jnp.where(app_valid, gcount + jnp.arange(H, dtype=jnp.int32), G)
+    ghost = jax.tree.map(
+        lambda gh, rc: gh.at[idx].set(rc, mode="drop"), ghost, recv
+    )
+    return ghost, jnp.minimum(gcount + recv_cnt, G), overflow
+
+
 def shard_halo_fn(
     domain: Domain,
     grid: ProcessGrid,
@@ -68,17 +188,7 @@ def shard_halo_fn(
     Signature: ``(pos[N,D], count[1], *fields) ->
     (ghost_pos[G,D], ghost_count[1], *ghost_fields, overflow[1])``.
     """
-    ndim = domain.ndim
-    widths = _as_per_axis(halo_width, ndim)
-    cell_w = grid.cell_widths(domain)
-    for a in range(ndim):
-        if widths[a] < 0:
-            raise ValueError(f"halo_width[{a}] must be >= 0")
-        if widths[a] > cell_w[a]:
-            raise ValueError(
-                f"halo_width[{a}]={widths[a]} exceeds subdomain width "
-                f"{cell_w[a]}; multi-hop halos are not supported"
-            )
+    widths, cell_w = _validate_widths(domain, grid, halo_width)
     H, G = pass_capacity, ghost_capacity
 
     def fn(pos, count, *fields):
@@ -112,41 +222,15 @@ def shard_halo_fn(
             cand_valid = jnp.concatenate(
                 [valid, jnp.arange(G, dtype=jnp.int32) < gcount]
             )
-            coord = cand[0][:, a]
 
             incoming = []
             for dirn in (1, -1):
-                if dirn == 1:
-                    mask = cand_valid & (coord >= hi_a - w)
-                    at_edge = coord_idx == g - 1
-                else:
-                    mask = cand_valid & (coord < lo_a + w)
-                    at_edge = coord_idx == 0
-                if not domain.periodic[a]:
-                    mask = mask & jnp.logical_not(at_edge)
-                cnt = jnp.sum(mask.astype(jnp.int32))
-                overflow = overflow + jnp.maximum(cnt - H, 0)
-                send_cnt = jnp.minimum(cnt, H)
-                order = _stable_order(~mask)
-                take = _take_rows(order, H)
-                slot_valid = jnp.arange(H, dtype=jnp.int32) < send_cnt
-                send = jax.tree.map(
-                    lambda arr: _mask_rows(
-                        jnp.take(arr, take, axis=0), slot_valid
-                    ),
-                    cand,
+                at_edge = coord_idx == (g - 1 if dirn == 1 else 0)
+                send, send_cnt, ov = _select_for_pass(
+                    cand, cand_valid, a, dirn, lo_a, hi_a, w, at_edge,
+                    domain.periodic[a], extent_a, H,
                 )
-                # Periodic wrap: shift the ghost coordinate into the
-                # receiver's frame (+1 across hi wrap -> subtract extent).
-                shift = jnp.where(
-                    at_edge & domain.periodic[a],
-                    -jnp.asarray(dirn, pos.dtype) * extent_a,
-                    jnp.asarray(0, pos.dtype),
-                )
-                send_pos = send[0].at[:, a].add(
-                    jnp.where(slot_valid, shift, 0)
-                )
-                send = (send_pos,) + tuple(send[1:])
+                overflow = overflow + ov
                 perm = [(i, (i + dirn) % g) for i in range(g)]
                 recv = jax.tree.map(
                     lambda arr: lax.ppermute(arr, name, perm), send
@@ -155,17 +239,9 @@ def shard_halo_fn(
                 incoming.append((recv, recv_cnt))
 
             for recv, recv_cnt in incoming:
-                app_valid = jnp.arange(H, dtype=jnp.int32) < recv_cnt
-                overflow = overflow + jnp.maximum(gcount + recv_cnt - G, 0)
-                idx = jnp.where(
-                    app_valid, gcount + jnp.arange(H, dtype=jnp.int32), G
+                ghost, gcount, overflow = _append_recv(
+                    ghost, gcount, overflow, recv, recv_cnt, H, G
                 )
-                ghost = jax.tree.map(
-                    lambda gh, rc: gh.at[idx].set(rc, mode="drop"),
-                    ghost,
-                    recv,
-                )
-                gcount = jnp.minimum(gcount + recv_cnt, G)
 
         return (
             (ghost[0], gcount[None])
@@ -176,33 +252,180 @@ def shard_halo_fn(
     return fn
 
 
-def build_halo_exchange(
-    mesh: Mesh,
+def vrank_halo_fn(
     domain: Domain,
     grid: ProcessGrid,
     halo_width,
     pass_capacity: int,
     ghost_capacity: int,
+):
+    """V-rank halo exchange on ONE device (virtual ranks, vmapped).
+
+    Semantically identical to :func:`shard_halo_fn` over a V-way mesh —
+    the per-slab selection, frame shift, and append are literally the same
+    helpers — but the ranks are vmapped slabs on one device and each
+    ``lax.ppermute`` becomes the roll along the row-major grid axis it
+    would perform on the wire (receiver ``j`` gets sender ``j - dirn``,
+    i.e. ``jnp.roll(send, +dirn, axis=a)`` on the grid-shaped view).
+
+    Signature: ``(pos[V, n, D], count[V], *fields[V, n, ...]) ->
+    (ghost_pos[V, G, D], ghost_count[V], *ghost_fields, overflow[V])``.
+    """
+    widths, cell_w = _validate_widths(domain, grid, halo_width)
+    H, G = pass_capacity, ghost_capacity
+    V = grid.nranks
+    ndim = domain.ndim
+
+    def fn(pos, count, *fields):
+        n = pos.shape[1]
+        arrays = (pos,) + tuple(fields)
+        valid = jnp.arange(n, dtype=jnp.int32)[None, :] < count[:, None]
+        ghost = jax.tree.map(
+            lambda a: jnp.zeros((V, G) + a.shape[2:], a.dtype), arrays
+        )
+        gcount = jnp.zeros((V,), jnp.int32)
+        overflow = jnp.zeros((V,), jnp.int32)
+        ranks = jnp.arange(V, dtype=jnp.int32)
+        strides = grid.strides
+
+        for a in range(ndim):
+            g = grid.shape[a]
+            w = jnp.asarray(widths[a], pos.dtype)
+            extent_a = jnp.asarray(domain.extent[a], pos.dtype)
+            coord_idx = (ranks // strides[a]) % g  # row-major cell coords
+            lo_a = (
+                jnp.asarray(domain.lo[a], pos.dtype)
+                + coord_idx.astype(pos.dtype)
+                * jnp.asarray(cell_w[a], pos.dtype)
+            )
+            hi_a = lo_a + jnp.asarray(cell_w[a], pos.dtype)
+
+            cand = jax.tree.map(
+                lambda own, gh: jnp.concatenate([own, gh], axis=1),
+                arrays,
+                ghost,
+            )
+            cand_valid = jnp.concatenate(
+                [valid, jnp.arange(G, dtype=jnp.int32)[None, :] < gcount[:, None]],
+                axis=1,
+            )
+
+            incoming = []
+            for dirn in (1, -1):
+                at_edge = coord_idx == (g - 1 if dirn == 1 else 0)
+                send, send_cnt, ov = jax.vmap(
+                    lambda cand_v, cv_v, lo_v, hi_v, edge_v: _select_for_pass(
+                        cand_v, cv_v, a, dirn, lo_v, hi_v, w, edge_v,
+                        domain.periodic[a], extent_a, H,
+                    )
+                )(cand, cand_valid, lo_a, hi_a, at_edge)
+                overflow = overflow + ov
+                # the wire, as a roll on the grid-shaped vrank axis:
+                # receiver j gets sender j - dirn along axis a
+                recv = jax.tree.map(
+                    lambda arr: jnp.roll(
+                        arr.reshape(grid.shape + arr.shape[1:]), dirn, axis=a
+                    ).reshape(arr.shape),
+                    send,
+                )
+                recv_cnt = jnp.roll(
+                    send_cnt.reshape(grid.shape), dirn, axis=a
+                ).reshape((V,))
+                incoming.append((recv, recv_cnt))
+
+            for recv, recv_cnt in incoming:
+                ghost, gcount, overflow = jax.vmap(
+                    lambda gh_v, gc_v, ov_v, rc_v, rcnt_v: _append_recv(
+                        gh_v, gc_v, ov_v, rc_v, rcnt_v, H, G
+                    )
+                )(ghost, gcount, overflow, recv, recv_cnt)
+
+        return (ghost[0], gcount) + tuple(ghost[1:]) + (overflow,)
+
+    return fn
+
+
+def build_halo_vranks(
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+):
+    """jit of :func:`vrank_halo_fn` (single-device, [V, n, ...] slabs)."""
+    # normalize the width to a hashable tuple so per-axis lists hit the cache
+    widths = _as_per_axis(halo_width, domain.ndim)
+    return _build_halo_vranks_cached(
+        domain, grid, widths, pass_capacity, ghost_capacity
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_halo_vranks_cached(
+    domain: Domain,
+    grid: ProcessGrid,
+    widths: Tuple[float, ...],
+    pass_capacity: int,
+    ghost_capacity: int,
+):
+    return jax.jit(
+        vrank_halo_fn(domain, grid, widths, pass_capacity, ghost_capacity)
+    )
+
+
+def build_halo_exchange(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int | None = None,
+    ghost_capacity: int | None = None,
     n_fields: int = 0,
+    headroom: float = 2.0,
 ):
     """jit-compiled global halo exchange over ``mesh``.
 
     Global layout matches the redistribute: ``pos`` [R*n_local, D] /
     ``count`` [R] sharded over the grid axes; returns a :class:`HaloResult`.
+
+    ``pass_capacity`` / ``ghost_capacity`` default to
+    :func:`default_capacities` sized from each call's per-shard row count
+    (one cached compile per distinct size); pass explicit ints to pin the
+    ghost-buffer shape across calls. Overflow past either capacity is
+    counted per shard in ``HaloResult.overflow``.
     """
     mesh_lib.validate_mesh_for_grid(mesh, grid)
+    _validate_widths(domain, grid, halo_width)
     spec = P(grid.axis_names)
-    fn = shard_halo_fn(domain, grid, halo_width, pass_capacity, ghost_capacity)
-    sharded = shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(spec, spec) + (spec,) * n_fields,
-        out_specs=(spec, spec) + (spec,) * n_fields + (spec,),
-    )
-    jitted = jax.jit(sharded)
+    built = {}  # n_local -> jitted fn (kept: discarding one drops its jit cache)
+
+    def _build(n_local: int):
+        pc, gc = pass_capacity, ghost_capacity
+        if pc is None or gc is None:
+            dpc, dgc = default_capacities(
+                domain, grid, halo_width, n_local, headroom
+            )
+            pc = dpc if pc is None else pc
+            gc = dgc if gc is None else gc
+        fn = shard_halo_fn(domain, grid, halo_width, pc, gc)
+        sharded = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, spec) + (spec,) * n_fields,
+            out_specs=(spec, spec) + (spec,) * n_fields + (spec,),
+        )
+        return jax.jit(sharded)
 
     def wrapped(pos, count, *fields):
-        out = jitted(pos, count, *fields)
+        # capacities pinned => one build serves every input size
+        key = (
+            pos.shape[0] // grid.nranks
+            if pass_capacity is None or ghost_capacity is None
+            else 0
+        )
+        if key not in built:
+            built[key] = _build(key)
+        out = built[key](pos, count, *fields)
         return HaloResult(out[0], out[1], tuple(out[2:-1]), out[-1])
 
     return wrapped

@@ -82,6 +82,52 @@ def trace(log_dir: str):
         yield
 
 
+# Peak-bandwidth constants for the utilization denominator (BASELINE.json
+# metric: "ICI all_to_all BW util"; SURVEY.md §5.1). Datasheet values for
+# TPU v5e, the chip family this repo benches on:
+#   * HBM: 819 GB/s per chip — the roof for the single-chip vrank exchange,
+#     whose "wire" is HBM-side gathers/scatters (exchange_domain == "hbm").
+#   * ICI: 45 GB/s one-way per link, 4 links per chip (2D torus) — the roof
+#     for the >=8-device all_to_all (exchange_domain == "ici"). all_to_all
+#     traffic spreads over every link, so the per-chip roof is the sum of
+#     link rates; a torus-bisection argument would halve it for worst-case
+#     placements, which would *raise* the reported utilization — using the
+#     full sum keeps the figure conservative.
+HBM_PEAK_BYTES_PER_SEC = 819e9
+ICI_LINK_BYTES_PER_SEC = 45e9
+ICI_LINKS_PER_CHIP = 4
+
+
+def exchange_peak_bytes_per_sec(domain: str) -> float:
+    """Peak bytes/s for an exchange domain, per chip.
+
+    ``domain`` is the ``exchange_domain`` bench.py reports: ``"hbm"`` when
+    the vrank exchange stays on one chip, ``"ici"`` when rows ride the
+    inter-chip all_to_all. The ICI roof assumes all ``ICI_LINKS_PER_CHIP``
+    links active (see constant comment for why that is the conservative
+    choice for utilization).
+    """
+    if domain == "hbm":
+        return HBM_PEAK_BYTES_PER_SEC
+    if domain == "ici":
+        return ICI_LINK_BYTES_PER_SEC * ICI_LINKS_PER_CHIP
+    raise ValueError(f"unknown exchange domain {domain!r}")
+
+
+def exchange_bw_util(
+    bytes_per_sec: float, domain: str, n_chips: int = 1
+) -> float:
+    """Fraction of the domain's peak bandwidth the exchange achieves.
+
+    This completes the BASELINE metric: ``exchange_bytes_per_sec`` divided
+    by the peak for the domain it crossed (HBM on one chip, summed ICI
+    links per chip otherwise). ``bytes_per_sec`` should be aggregate
+    payload bytes / step time; for multi-chip runs pass the aggregate and
+    the chip count so the per-chip figure is compared to a per-chip roof.
+    """
+    return bytes_per_sec / n_chips / exchange_peak_bytes_per_sec(domain)
+
+
 def exchange_bytes_per_step(stats, row_bytes: int) -> float:
     """Mean bytes crossing the exchange per step, from a stats pytree.
 

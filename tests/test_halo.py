@@ -146,3 +146,100 @@ def test_halo_overflow_counted(rng):
     hres = hx(res.positions, res.count)
     assert int(np.asarray(hres.overflow).sum()) > 0
     assert (np.asarray(hres.ghost_count) <= 8).all()
+
+
+def test_default_capacities_uniform_headroom():
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    pc, gc = halo_lib.default_capacities(domain, grid, 0.05, 1000)
+    # f = w/cell_w = 0.1 per direction; ghosts ~ (1.2^3 - 1)*1000 = 728
+    assert 728 * 2 <= gc <= 728 * 2 + 8
+    assert pc >= 2 * 100  # last-axis pass ~ 100 * 1.2^2 rows, 2x headroom
+    with pytest.raises(ValueError):
+        halo_lib.default_capacities(domain, grid, 0.05, 0)
+
+
+def test_halo_auto_capacities_no_overflow(rng):
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 128
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=3 * n_local)
+    res = rd.redistribute(pos)
+    mesh = mesh_lib.make_mesh(grid)
+    hx = halo_lib.build_halo_exchange(mesh, domain, grid, 0.08)
+    hres = hx(res.positions, res.count)
+    assert int(np.asarray(hres.overflow).sum()) == 0
+    assert int(np.asarray(hres.ghost_count).sum()) > 0
+
+
+@pytest.mark.parametrize(
+    "grid_shape,periodic",
+    [((2, 2, 2), True), ((2, 2, 2), False), ((4, 2, 1), True)],
+)
+def test_vrank_halo_matches_brute_force(rng, grid_shape, periodic):
+    """The single-device vrank twin reproduces the brute-force ghost sets."""
+    domain = Domain(0.0, 1.0, periodic=periodic)
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    n_local = 64
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=3 * n_local)
+    res = rd.redistribute(pos)
+    count = np.asarray(res.count)
+    oc = res.positions.shape[0] // R
+    w = 0.08
+    G = 1024
+    hv = halo_lib.build_halo_vranks(domain, grid, w, 256, G)
+    gpos, gcount, overflow = hv(
+        np.asarray(res.positions).reshape(R, oc, 3), count
+    )
+    gpos, gcount = np.asarray(gpos), np.asarray(gcount)
+    assert int(np.asarray(overflow).sum()) == 0
+
+    shards = [
+        np.asarray(res.positions)[r * oc : r * oc + count[r]]
+        for r in range(R)
+    ]
+    expected = brute_force_ghosts(domain, grid, shards, w)
+    for r in range(R):
+        got = gpos[r, : gcount[r]]
+        exp = expected[r]
+        assert gcount[r] == len(exp), f"rank {r}: {gcount[r]} vs {len(exp)}"
+        np.testing.assert_allclose(
+            _sorted_rows(got), _sorted_rows(exp), atol=1e-5
+        )
+
+
+def test_vrank_halo_matches_shard_map(rng):
+    """Both engines produce identical ghost multisets (bit-level rows)."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 48
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=2 * n_local)
+    res = rd.redistribute(pos)
+    oc = res.positions.shape[0] // R
+    w, H, G = 0.1, 128, 512
+    mesh = mesh_lib.make_mesh(grid)
+    hx = halo_lib.build_halo_exchange(
+        mesh, domain, grid, w, pass_capacity=H, ghost_capacity=G
+    )
+    hres = hx(res.positions, res.count)
+    hv = halo_lib.build_halo_vranks(domain, grid, w, H, G)
+    vpos, vcount, voverflow = hv(
+        np.asarray(res.positions).reshape(R, oc, 3), np.asarray(res.count)
+    )
+    gcount = np.asarray(hres.ghost_count)
+    np.testing.assert_array_equal(gcount, np.asarray(vcount))
+    np.testing.assert_array_equal(
+        np.asarray(hres.overflow), np.asarray(voverflow)
+    )
+    spos = np.asarray(hres.ghost_positions).reshape(R, G, 3)
+    for r in range(R):
+        a = _sorted_rows(spos[r, : gcount[r]]).view(np.uint32)
+        b = _sorted_rows(np.asarray(vpos)[r, : gcount[r]]).view(np.uint32)
+        np.testing.assert_array_equal(a, b)

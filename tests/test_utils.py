@@ -137,3 +137,16 @@ def test_exchange_bytes_per_step():
         dropped_recv=np.zeros((2, 8), np.int32),
     )
     assert profiling.exchange_bytes_per_step(st, 28) == 800 * 28
+
+
+def test_exchange_bw_util():
+    # hbm domain: fraction of the 819 GB/s v5e HBM roof
+    util = profiling.exchange_bw_util(819e9 / 2, "hbm")
+    assert abs(util - 0.5) < 1e-12
+    # ici domain: per-chip aggregate vs 4 summed 45 GB/s links
+    peak = profiling.exchange_peak_bytes_per_sec("ici")
+    assert peak == 4 * 45e9
+    util = profiling.exchange_bw_util(8 * peak * 0.25, "ici", n_chips=8)
+    assert abs(util - 0.25) < 1e-12
+    with pytest.raises(ValueError):
+        profiling.exchange_peak_bytes_per_sec("dcn")
