@@ -29,21 +29,8 @@ from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.models import nbody
 from mpi_grid_redistribute_tpu.bench import common
 from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
 from mpi_grid_redistribute_tpu.utils import stats as stats_lib, profiling
-
-
-def _placed_state(pos_rows, owner, R, n_local, rng):
-    """Scatter rows onto their owner slabs (numpy host prep, not timed)."""
-    n = R * n_local
-    pos = np.zeros((n, 3), np.float32)
-    alive = np.zeros((n,), bool)
-    for r in range(R):
-        rows = pos_rows[owner == r]
-        k = len(rows)
-        assert k <= n_local, (r, k, n_local)
-        pos[r * n_local : r * n_local + k] = rows
-        alive[r * n_local : r * n_local + k] = True
-    return pos, alive
 
 
 def run(
@@ -103,55 +90,100 @@ def run(
     )
 
     # ---- phase 2: steady-state drift throughput, imbalanced vs uniform
-    # Slab size comes from the measured hottest subdomain (nothing may
-    # drop); total rows identical in both runs so pps compares honestly.
+    # Round 2 sized every slab by the hottest SUBDOMAIN (9.4x slot waste
+    # at 7.2x imbalance — round-2 verdict item 7). Round 3 balances the
+    # DECOMPOSITION instead: the 64 cells are LPT-assigned to V=8 vranks
+    # by measured load (migrate.balanced_assignment), so uniform static
+    # slabs sized ~mean load carry the same clustered data; each workload
+    # gets its own measured-histogram assignment, the slab size is shared
+    # (max bin across both), and pps compares the same total rows.
     # lognormal(-1.0, 1.5) mod 1 concentrates ~7x the mean load on the
-    # hottest subdomain (the VERDICT's "vranks holding up to ~8x mean");
-    # the hot slab then holds ~11% of ALL rows, so total is sized to keep
-    # the uniform-slab state within HBM.
+    # hottest subdomain (the VERDICT's "vranks holding up to ~8x mean").
+    from mpi_grid_redistribute_tpu.parallel import migrate as migrate_lib
+
     total = R * n_base // 4
     cluster_rows = (
         rng.lognormal(-1.0, 1.5, size=(total, 3)) % 1.0
     ).astype(np.float32)
-    owner = binning.rank_of_position(cluster_rows, domain, full_grid, xp=np)
-    counts = np.bincount(owner, minlength=R)
+    cell_c = binning.rank_of_position(cluster_rows, domain, full_grid, xp=np)
+    counts = np.bincount(cell_c, minlength=R)
     imbalance = float(counts.max() / counts.mean())
-    n_slab = -(-math.ceil(counts.max() * 1.3) // 4096) * 4096
+
+    # phase-2 layout: 8 balanced storage ranks — one per device when >= 8
+    # devices exist (V=1 vranks, the assignment targets dev-major global
+    # rank ids either way), all as vranks on one device otherwise
+    devs = jax.devices()
+    if len(devs) >= 8:
+        ss_dev_grid = ProcessGrid((2, 2, 2))
+        ss_vgrid = ProcessGrid((1, 1, 1))
+        ss_mesh = mesh_lib.make_mesh(ss_dev_grid, devices=devs[:8])
+    else:
+        ss_dev_grid = ProcessGrid((1, 1, 1))
+        ss_vgrid = ProcessGrid((2, 2, 2))
+        ss_mesh = mesh
+    Vss = ss_dev_grid.nranks * ss_vgrid.nranks  # total storage ranks: 8
+    assign_c = migrate_lib.balanced_assignment(counts, Vss)
+    owner_c = np.asarray(assign_c)[cell_c]
+    bins_c = np.bincount(owner_c, minlength=Vss)
+
+    uniform_rows = rng.random((total, 3), dtype=np.float32)
+    cell_u = binning.rank_of_position(uniform_rows, domain, full_grid, xp=np)
+    assign_u = migrate_lib.balanced_assignment(
+        np.bincount(cell_u, minlength=R), Vss
+    )
+    owner_u = np.asarray(assign_u)[cell_u]
+    bins_u = np.bincount(owner_u, minlength=Vss)
+
+    n_slab = -(-math.ceil(max(bins_c.max(), bins_u.max()) * 1.3)
+               // 4096) * 4096
+    waste = Vss * n_slab / total
     v_scale = migration / 3.0 * 2.0 / np.asarray(grid_shape, np.float32)
 
-    # capacities sized to the hot slab's migrant flux
-    distinct = 6  # 4^3 grid: 6 distinct face neighbors
-    ss_cap = max(64, math.ceil(counts.max() * migration / distinct * 2.0))
-    budget = max(256, math.ceil(counts.max() * migration * 2.0))
-    ss_cfg = nbody.DriftConfig(
-        domain=domain, grid=dev_grid, dt=1.0, capacity=ss_cap,
-        n_local=n_slab, local_budget=budget,
-    )
+    # capacities sized to the (balanced) hot slab's migrant flux
+    hot = max(bins_c.max(), bins_u.max())
+    ss_cap = max(64, math.ceil(hot * migration * 2.0))
+    budget = max(256, math.ceil(hot * migration * 2.0))
 
-    def measure(pos_np, alive_np):
+    def measure(rows, owner, assign):
         vel_np = (
-            v_scale * (rng.random(pos_np.shape, dtype=np.float32) * 2 - 1)
+            v_scale * (rng.random(rows.shape, dtype=np.float32) * 2 - 1)
         ).astype(np.float32)
+        pos_np = np.zeros((Vss * n_slab, 3), np.float32)
+        vel_p = np.zeros((Vss * n_slab, 3), np.float32)
+        alive_np = np.zeros((Vss * n_slab,), bool)
+        for v in range(Vss):
+            m = owner == v
+            k = int(m.sum())
+            pos_np[v * n_slab : v * n_slab + k] = rows[m]
+            vel_p[v * n_slab : v * n_slab + k] = vel_np[m]
+            alive_np[v * n_slab : v * n_slab + k] = True
+        ss_cfg = nbody.DriftConfig(
+            domain=domain, grid=ss_dev_grid, dt=1.0, capacity=ss_cap,
+            n_local=n_slab, local_budget=budget,
+            cells=full_grid, assignment=assign,
+        )
         args = (
-            jax.device_put(jnp.asarray(nbody.rows_to_planar(pos_np, mesh.size))),
-            jax.device_put(jnp.asarray(nbody.rows_to_planar(vel_np, mesh.size))),
+            jax.device_put(
+                jnp.asarray(nbody.rows_to_planar(pos_np, ss_mesh.size))
+            ),
+            jax.device_put(
+                jnp.asarray(nbody.rows_to_planar(vel_p, ss_mesh.size))
+            ),
             jax.device_put(jnp.asarray(alive_np)),
         )
         per_step, _, long_out = profiling.scan_time_per_step(
-            lambda S: nbody.make_migrate_loop(ss_cfg, mesh, S, vgrid=vgrid),
+            lambda S: nbody.make_migrate_loop(
+                ss_cfg, ss_mesh, S, vgrid=ss_vgrid
+            ),
             args, s1=4, s2=20,
         )
         st = jax.tree.map(np.asarray, long_out[3])
         return per_step, st
 
-    pos_c, alive_c = _placed_state(cluster_rows, owner, R, n_slab, rng)
-    per_c, st_c = measure(pos_c, alive_c)
+    per_c, st_c = measure(cluster_rows, owner_c, assign_c)
     dropped_c = int(st_c.dropped_recv.sum())
 
-    pos_u, vel_u, alive_u = common.uniform_state(
-        grid_shape, n_slab, total / (R * n_slab), rng
-    )
-    per_u, st_u = measure(pos_u, alive_u)
+    per_u, st_u = measure(uniform_rows, owner_u, assign_u)
     dropped_u = int(st_u.dropped_recv.sum())
 
     pps_imb = total / per_c
@@ -159,7 +191,9 @@ def run(
     common.log(
         f"config2 steady-state: imbalanced {per_c*1e3:.2f} ms/step vs "
         f"uniform {per_u*1e3:.2f} ms/step at {total} rows "
-        f"(imbalance {imbalance:.2f}x, slab {n_slab})"
+        f"(cell imbalance {imbalance:.2f}x, balanced-bin imbalance "
+        f"{bins_c.max()/bins_c.mean():.3f}x, slab {n_slab}, "
+        f"waste {waste:.2f}x)"
     )
 
     res = {
@@ -170,6 +204,14 @@ def run(
         "pps_uniform_ref": round(pps_uni, 2),
         "imbalanced_over_uniform": round(pps_imb / pps_uni, 3),
         "ownership_imbalance": round(imbalance, 3),
+        # slot waste under imbalance: total slab slots / live rows. Round 2
+        # sized slabs by the hottest subdomain (9.4x at 7.2x imbalance);
+        # the balanced cell->vrank assignment keeps it near the 1.3x
+        # headroom + rounding (round-2 verdict item 7, target < 3x)
+        "slot_waste_factor": round(waste, 3),
+        "balanced_bin_imbalance": round(
+            float(bins_c.max() / bins_c.mean()), 4
+        ),
         "dropped_recv": dropped_c + dropped_u,
         # placement phase is lossless by contract (backlog retries instead
         # of dropping); surfaced separately so it is actually checked

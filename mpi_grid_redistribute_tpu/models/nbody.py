@@ -45,6 +45,12 @@ class DriftConfig:
     # path's compact routing (None -> V * capacity); see
     # parallel.migrate.shard_migrate_vranks_fn
     local_budget: Optional[int] = None
+    # load-balanced decomposition for the vrank migrate path: the spatial
+    # cell grid plus a static row-major cell -> global-rank tuple
+    # (migrate.balanced_assignment). Both or neither; vgrid then only
+    # fixes the vrank count. See shard_migrate_vranks_fn.
+    cells: Optional[ProcessGrid] = None
+    assignment: Optional[Tuple[int, ...]] = None
 
 
 def make_drift_step(cfg: DriftConfig, mesh: Mesh):
@@ -248,13 +254,24 @@ def make_migrate_loop(
     D = cfg.domain.ndim
     V = 1 if vgrid is None else vgrid.nranks
     if vgrid is None:
+        if cfg.assignment is not None or cfg.cells is not None:
+            raise ValueError(
+                "cells/assignment require the vrank path (pass vgrid)"
+            )
         mig = migrate.shard_migrate_fused_fn(
             cfg.domain, cfg.grid, cfg.capacity
         )
     else:
+        if cfg.assignment is not None and cfg.deposit_shape is not None:
+            raise ValueError(
+                "assignment-decomposed vranks own non-contiguous cell "
+                "sets; the per-vrank block deposit assumes spatial "
+                "slabs — deposit on the canonical layout instead"
+            )
         mig = migrate.shard_migrate_vranks_fn(
             cfg.domain, cfg.grid, vgrid, cfg.capacity,
             local_budget=cfg.local_budget,
+            cells=cfg.cells, assignment=cfg.assignment,
         )
     dep_fn = None
     if cfg.deposit_shape is not None:
@@ -302,7 +319,7 @@ def make_migrate_loop(
             ],
             axis=0,
         )
-        state = migrate.init_state(fused, vranks=V)
+        state = migrate.init_state(fused, vranks=V, batched=vgrid is not None)
         # scan requires carry leaves already marked device-varying (some
         # init_state outputs are iota-derived and start unvaried)
         def _vary(x):
