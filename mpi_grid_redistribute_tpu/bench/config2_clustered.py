@@ -154,6 +154,7 @@ def run(
         for v in range(Vss):
             m = owner == v
             k = int(m.sum())
+            assert k <= n_slab, (v, k, n_slab)
             pos_np[v * n_slab : v * n_slab + k] = rows[m]
             vel_p[v * n_slab : v * n_slab + k] = vel_np[m]
             alive_np[v * n_slab : v * n_slab + k] = True

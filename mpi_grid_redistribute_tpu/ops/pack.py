@@ -112,6 +112,33 @@ def _finish_compact(values, order, new_count_full, out_capacity: int):
     return out, new_count.astype(jnp.int32), dropped.astype(jnp.int32)
 
 
+def pool_source_keys(recv_counts: jax.Array, self_mask: jax.Array, me,
+                     capacity: int):
+    """Alltoallv-order keys for a [R, capacity] receive pool + local rows.
+
+    Returns ``(invalid, source_key)`` over the concatenated
+    ``[R * capacity + n]`` pool: remote slot (s, c) carries source ``s``
+    (valid iff ``c < recv_counts[s]``), local row carries source ``me``
+    (valid iff ``self_mask``). Sorting by (invalid, source_key, position)
+    is exactly MPI Alltoallv receive order with self rows spliced at
+    source position ``me`` — the invariant shared by
+    :func:`compact_with_self` (row-major) and the planar engine's
+    payload-sort compaction (``exchange.vrank_redistribute_planar_fn``);
+    keep it in one place so the two cannot drift.
+    """
+    R = recv_counts.shape[0]
+    n = self_mask.shape[0]
+    c_idx = jnp.arange(capacity, dtype=jnp.int32)
+    valid_r = (c_idx[None, :] < recv_counts[:, None]).reshape(R * capacity)
+    src_r = jnp.broadcast_to(
+        jnp.arange(R, dtype=jnp.int32)[:, None], (R, capacity)
+    ).reshape(R * capacity)
+    src_s = jnp.full((n,), me, dtype=jnp.int32)
+    invalid = ~jnp.concatenate([valid_r, self_mask])
+    source_key = jnp.concatenate([src_r, src_s])
+    return invalid, source_key
+
+
 def compact_with_self(
     recv,
     recv_counts: jax.Array,
@@ -143,18 +170,12 @@ def compact_with_self(
     """
     first = jax.tree.leaves(recv)[0]
     R, capacity = first.shape[0], first.shape[1]
-    n = jax.tree.leaves(local)[0].shape[0]
-    c_idx = jnp.arange(capacity, dtype=jnp.int32)
-    valid_r = (c_idx[None, :] < recv_counts[:, None]).reshape(R * capacity)
     # Source rank per pooled row: s for remote slot (s, c), `me` for local
     # rows. No valid collision within a source: recv_counts[me] == 0, so
     # the stable iota tiebreak fully orders rows within each source.
-    src_r = jnp.broadcast_to(
-        jnp.arange(R, dtype=jnp.int32)[:, None], (R, capacity)
-    ).reshape(R * capacity)
-    src_s = jnp.full((n,), me, dtype=jnp.int32)
-    invalid = ~jnp.concatenate([valid_r, self_mask])
-    source_key = jnp.concatenate([src_r, src_s])
+    invalid, source_key = pool_source_keys(
+        recv_counts, self_mask, me, capacity
+    )
     order = _stable_order(invalid, source_key)
     values = jax.tree.map(
         lambda a, b: jnp.concatenate(

@@ -1,0 +1,132 @@
+import os, sys, time, math
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning, pack as pack_lib
+from mpi_grid_redistribute_tpu.parallel import exchange
+from mpi_grid_redistribute_tpu.parallel.migrate import _pack_cols
+from mpi_grid_redistribute_tpu.utils import profiling
+
+V = 8
+vgrid = ProcessGrid((2,2,2))
+domain = Domain(0.0, 1.0, periodic=True)
+n_loc = 524288
+slots = int(n_loc * 1.25)
+migration = 0.02
+cap = max(64, math.ceil(n_loc * migration / 3 * 2.5))
+C = cap
+rng = np.random.default_rng(1)
+from mpi_grid_redistribute_tpu.bench import common as bc
+p0, v0, _ = bc.uniform_state((2,2,2), n_loc, 1.0, rng,
+    vel_scale=migration/3.0*2.0/np.asarray((2,2,2),np.float32))
+posv = np.zeros((V, slots, 3), np.float32); posv[:, :n_loc] = p0.reshape(V, n_loc, 3)
+velv = np.zeros((V, slots, 3), np.float32); velv[:, :n_loc] = v0.reshape(V, n_loc, 3)
+fused = np.ascontiguousarray(np.concatenate(
+    [posv.transpose(0,2,1), velv.transpose(0,2,1)], axis=1))
+countv = np.full((V,), n_loc, np.int32)
+D = 3
+n = slots
+out_capacity = slots
+
+def stage_fn(upto):
+    def fn(f, count):
+        me_ids = jnp.arange(V, dtype=jnp.int32)
+        def pack_one(f_v, count_v, me):
+            iota = jnp.arange(n, dtype=jnp.int32)
+            valid = iota < count_v
+            dest = binning.rank_of_position_planar(f_v[:D], domain, vgrid)
+            dest = jnp.where(valid, dest, V).astype(jnp.int32)
+            is_self = valid & (dest == me)
+            dest_remote = jnp.where(is_self, V, dest)
+            order, remote_counts, bounds = binning.sorted_dest_counts(dest_remote, V)
+            send_counts = jnp.minimum(remote_counts, C)
+            packed, _ = _pack_cols(f_v, order, bounds[:V], send_counts, V, C)
+            return packed, send_counts, is_self
+        packed, send_counts, is_self = jax.vmap(pack_one)(f, count, me_ids)
+        if upto == 1:
+            return packed.sum() + send_counts.sum()
+        K = f.shape[1]
+        recv = packed.reshape(V,K,V,C).transpose(2,1,0,3).reshape(V,K,V*C)
+        recv_counts = send_counts.T
+        if upto == 2:
+            return recv.sum() + recv_counts.sum()
+        def compact_one(pool_v, rcnt_v, me, self_mask_v, f_v):
+            c_idx = jnp.arange(C, dtype=jnp.int32)
+            valid_r = (c_idx[None,:] < rcnt_v[:,None]).reshape(V*C)
+            src_r = jnp.broadcast_to(jnp.arange(V,dtype=jnp.int32)[:,None],(V,C)).reshape(V*C)
+            src_s = jnp.full((n,), me, dtype=jnp.int32)
+            invalid = ~jnp.concatenate([valid_r, self_mask_v])
+            source_key = jnp.concatenate([src_r, src_s])
+            order = pack_lib._stable_order(invalid, source_key)
+            if upto == 3:
+                return order.sum()[None].astype(jnp.float32)
+            values = jnp.concatenate([pool_v, f_v], axis=1)
+            new_full = jnp.sum(rcnt_v) + jnp.sum(self_mask_v.astype(jnp.int32))
+            new_count = jnp.minimum(new_full, out_capacity)
+            take = pack_lib._take_rows(order, out_capacity)
+            col_valid = jnp.arange(out_capacity, dtype=jnp.int32) < new_count
+            out = jnp.where(col_valid[None,:], jnp.take(values, take, axis=1), 0)
+            return out
+        if upto == 5:
+            def compact_sort_one(pool_v, rcnt_v, me, self_mask_v, f_v):
+                c_idx = jnp.arange(C, dtype=jnp.int32)
+                valid_r = (c_idx[None,:] < rcnt_v[:,None]).reshape(V*C)
+                src_r = jnp.broadcast_to(jnp.arange(V,dtype=jnp.int32)[:,None],(V,C)).reshape(V*C)
+                src_s = jnp.full((n,), me, dtype=jnp.int32)
+                invalid = (~jnp.concatenate([valid_r, self_mask_v])).astype(jnp.int32)
+                source_key = jnp.concatenate([src_r, src_s])
+                values = jnp.concatenate([pool_v, f_v], axis=1)
+                m = values.shape[1]
+                iota = jnp.arange(m, dtype=jnp.int32)
+                K = values.shape[0]
+                operands = (invalid, source_key, iota) + tuple(values[k] for k in range(K))
+                out = jax.lax.sort(operands, num_keys=3, is_stable=False)
+                payload = jnp.stack(out[3:], axis=0)[:, :out_capacity]
+                new_full = jnp.sum(rcnt_v) + jnp.sum(self_mask_v.astype(jnp.int32))
+                new_count = jnp.minimum(new_full, out_capacity)
+                col_valid = jnp.arange(out_capacity, dtype=jnp.int32) < new_count
+                return jnp.where(col_valid[None,:], payload, 0)
+            r = jax.vmap(compact_sort_one)(recv, recv_counts, me_ids, is_self, f)
+            return r.sum()
+        if upto == 6:
+            def compact_sort2_one(pool_v, rcnt_v, me, self_mask_v, f_v):
+                c_idx = jnp.arange(C, dtype=jnp.int32)
+                valid_r = (c_idx[None,:] < rcnt_v[:,None]).reshape(V*C)
+                src_r = jnp.broadcast_to(jnp.arange(V,dtype=jnp.int32)[:,None],(V,C)).reshape(V*C)
+                src_s = jnp.full((n,), me, dtype=jnp.int32)
+                invalid = ~jnp.concatenate([valid_r, self_mask_v])
+                source_key = jnp.where(invalid, V, jnp.concatenate([src_r, src_s]))
+                values = jnp.concatenate([pool_v, f_v], axis=1)
+                m = values.shape[1]
+                iota = jnp.arange(m, dtype=jnp.int32)
+                K = values.shape[0]
+                operands = (source_key, iota) + tuple(values[k] for k in range(K))
+                out = jax.lax.sort(operands, num_keys=2, is_stable=False)
+                payload = jnp.stack(out[2:], axis=0)[:, :out_capacity]
+                new_full = jnp.sum(rcnt_v) + jnp.sum(self_mask_v.astype(jnp.int32))
+                new_count = jnp.minimum(new_full, out_capacity)
+                col_valid = jnp.arange(out_capacity, dtype=jnp.int32) < new_count
+                return jnp.where(col_valid[None,:], payload, 0)
+            r = jax.vmap(compact_sort2_one)(recv, recv_counts, me_ids, is_self, f)
+            return r.sum()
+        r = jax.vmap(compact_one)(recv, recv_counts, me_ids, is_self, f)
+        return r.sum() if upto >= 3 else r
+    return fn
+
+args = (jnp.asarray(fused), jnp.asarray(countv))
+for upto, label in [(1,"pack (bin+sort+gatherC)"), (2,"+transpose"), (3,"+compact sort"), (4,"+compact gather"), (5,"payload-sort compact (full)"), (6,"payload-sort 2key (full)")]:
+    sf = stage_fn(upto)
+    def make_loop(S, sf=sf):
+        @jax.jit
+        def loop(f, count):
+            def body(acc, _):
+                # the acc*1e-30 perturbation serializes iterations (no CSE hoist)
+                s = sf(f + acc * jnp.float32(1e-30), count)
+                return acc + jnp.asarray(s, jnp.float32).sum(), None
+            out, _ = lax.scan(body, jnp.float32(0), None, length=S)
+            return out
+        return loop
+    per, _, _ = profiling.scan_time_per_step(make_loop, args, s1=2, s2=8)
+    print(f"{label}: {per*1e3:.2f} ms")

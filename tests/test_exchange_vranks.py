@@ -55,3 +55,108 @@ def test_vrank_exchange_matches_oracle_bitlevel(rng, grid_shape, clustered):
                                   stats_o["dropped_recv"])
     np.testing.assert_array_equal(np.asarray(stats.needed_capacity),
                                   stats_o["needed_capacity"])
+
+
+def _to_planar_fused(pos, vel, ids, R, n_local):
+    """Host pack: [V, K, n] with pos rows, vel rows, bitcast id row."""
+    parts = [
+        pos.reshape(R, n_local, 3).transpose(0, 2, 1),
+        vel.reshape(R, n_local, 3).transpose(0, 2, 1),
+        ids.reshape(R, 1, n_local).view(np.float32),
+    ]
+    return np.ascontiguousarray(np.concatenate(parts, axis=1))
+
+
+@pytest.mark.parametrize("grid_shape", [(2, 2, 2), (4, 2, 1), (1, 1, 1)])
+@pytest.mark.parametrize("clustered", [False, True])
+def test_planar_vrank_exchange_matches_oracle_bitlevel(
+    rng, grid_shape, clustered
+):
+    """The planar [V, K, n] canonical engine produces byte-identical rows,
+    order, counts and stats to the padded oracle (and hence to the
+    row-major engine) — only the storage layout differs."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    n_local, cap, out_cap = 300, 120, 400
+    n = R * n_local
+    if clustered:
+        pos = (rng.lognormal(-1.5, 0.5, size=(n, 3)) % 1.0).astype(np.float32)
+    else:
+        pos = rng.random((n, 3)).astype(np.float32)
+    vel = rng.standard_normal((n, 3)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int32)
+    count = rng.integers(0, n_local + 1, size=R).astype(np.int32)
+
+    fused = _to_planar_fused(pos, vel, ids, R, n_local)
+    fn = exchange.build_redistribute_planar_vranks(
+        domain, grid, cap, out_cap
+    )
+    out, count_v, stats = fn(jnp.asarray(fused), jnp.asarray(count))
+    out = np.asarray(out)  # [V, 7, out_cap]
+    pos_v = out[:, 0:3, :].transpose(0, 2, 1)
+    vel_v = out[:, 3:6, :].transpose(0, 2, 1)
+    ids_v = out[:, 6, :].view(np.int32)
+
+    pos_o, count_o, (vel_o, ids_o), stats_o = oracle.redistribute_oracle_padded(
+        domain, grid, pos, count, [vel, ids], cap, out_cap
+    )
+    assert np.ascontiguousarray(pos_v).tobytes() == pos_o.tobytes()
+    assert np.ascontiguousarray(vel_v).tobytes() == vel_o.tobytes()
+    assert np.ascontiguousarray(ids_v).tobytes() == ids_o.tobytes()
+    np.testing.assert_array_equal(np.asarray(count_v), count_o)
+    np.testing.assert_array_equal(np.asarray(stats.send_counts),
+                                  stats_o["send_counts"])
+    np.testing.assert_array_equal(np.asarray(stats.dropped_send),
+                                  stats_o["dropped_send"])
+    np.testing.assert_array_equal(np.asarray(stats.dropped_recv),
+                                  stats_o["dropped_recv"])
+    np.testing.assert_array_equal(np.asarray(stats.needed_capacity),
+                                  stats_o["needed_capacity"])
+
+
+def test_planar_vrank_positions_only(rng):
+    """K = D (no extra fields) also round-trips bit-identically."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 1))
+    R, n_local, cap, out_cap = 4, 128, 96, 220
+    n = R * n_local
+    pos = rng.random((n, 3)).astype(np.float32)
+    count = np.full((R,), n_local, np.int32)
+    fused = np.ascontiguousarray(
+        pos.reshape(R, n_local, 3).transpose(0, 2, 1)
+    )
+    fn = exchange.build_redistribute_planar_vranks(domain, grid, cap, out_cap)
+    out, count_v, stats = fn(jnp.asarray(fused), jnp.asarray(count))
+    pos_v = np.asarray(out).transpose(0, 2, 1)
+    pos_o, count_o, _, _ = oracle.redistribute_oracle_padded(
+        domain, grid, pos, count, [], cap, out_cap
+    )
+    assert np.ascontiguousarray(pos_v).tobytes() == pos_o.tobytes()
+    np.testing.assert_array_equal(np.asarray(count_v), count_o)
+
+
+def test_planar_vrank_out_capacity_exceeds_pool(rng):
+    """out_capacity > V*C + n: the payload pad branch keeps shapes legal
+    and the tail zero (regression: found by the package-boundary drive)."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local, cap = 8, 32, 4
+    out_cap = 3 * n_local  # 96 > V*C + n = 64
+    n = R * n_local
+    pos = rng.random((n, 3)).astype(np.float32)
+    count = np.full((R,), n_local, np.int32)
+    fused = np.ascontiguousarray(
+        pos.reshape(R, n_local, 3).transpose(0, 2, 1)
+    )
+    fn = exchange.build_redistribute_planar_vranks(domain, grid, cap, out_cap)
+    out, cnt, stats = fn(jnp.asarray(fused), jnp.asarray(count))
+    pos_o, cnt_o, _, st_o = oracle.redistribute_oracle_padded(
+        domain, grid, pos, count, [], cap, out_cap
+    )
+    pos_v = np.ascontiguousarray(np.asarray(out).transpose(0, 2, 1))
+    assert pos_v.tobytes() == pos_o.tobytes()
+    np.testing.assert_array_equal(np.asarray(cnt), cnt_o)
+    np.testing.assert_array_equal(
+        np.asarray(stats.dropped_send), st_o["dropped_send"]
+    )
