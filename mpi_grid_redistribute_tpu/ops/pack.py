@@ -212,3 +212,26 @@ def compact_received(
     order = _stable_order(~valid)
     values = jax.tree.map(lambda a: a.reshape((total,) + a.shape[2:]), recv)
     return _finish_compact(values, order, jnp.sum(recv_counts), out_capacity)
+
+
+def pack_cols(fused, order, bounds, send_counts, n_dest: int,
+               capacity: int):
+    """Gather the first ``send_counts[d]`` sorted columns of each
+    destination segment into a ``[K, n_dest * C]`` send pool (zero in
+    invalid slots). Returns ``(send, gather_idx)``; ``gather_idx[j]`` is
+    the resident column feeding send slot ``j`` (unique over valid
+    slots). Shared by the migrate engine and the planar canonical
+    exchange (exchange.vrank_redistribute_planar_fn) — the planar twin of
+    :func:`pack_by_destination`."""
+    n = fused.shape[1]
+    C = capacity
+    c_idx = jnp.arange(C, dtype=jnp.int32)
+    flat_c = jnp.tile(c_idx, n_dest)
+    flat_d = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), C)
+    slot_valid = flat_c < send_counts[flat_d]
+    src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
+    gather_idx = order[src]  # [n_dest*C] unique over valid slots
+    send = jnp.where(
+        slot_valid[None, :], jnp.take(fused, gather_idx, axis=1), 0.0
+    )
+    return send, gather_idx

@@ -1,0 +1,196 @@
+"""Pallas TPU planar overlay scatter: ``flat[:, targets] = cols`` without
+per-element placement (SURVEY.md §7.5 item 7 — second attack on the
+landing-scatter wall).
+
+THE IDEA. XLA's scatter — and round 2's Pallas streamed-overlay kernel
+(ops/pallas_scatter.py) — both pay ~120-150 ns *per scattered element*:
+the placement is serialized whether it happens in the HBM scatter unit or
+as dynamic-sublane VMEM stores. This kernel removes per-element placement
+entirely:
+
+  1. (XLA side) sort arrivals by target column — a payload-carrying
+     ``lax.sort``, the same trick that won the canonical compaction
+     (parallel/exchange.py): sorts are cheap on TPU, placement is not;
+  2. stream the planar ``[K, m]`` state through VMEM in ``[K, W]``
+     lane-blocks; each block's arrivals are a *contiguous* range of the
+     sorted arrays (per-block ``starts`` via one searchsorted);
+  3. build each block's dense update as a ONE-HOT MATMUL on the MXU:
+     ``overlay = planes @ onehot`` where ``onehot[r, w] = (target[r] ==
+     block_base + w)`` — vectorized placement, no scalar stores;
+  4. blend: ``out = where(hit, overlay, in)`` with the hit row falling
+     out of the same matmul via a ones-row.
+
+BIT-EXACTNESS. The fused payload carries arbitrary 32-bit patterns
+(bitcast int fields routinely look like NaNs), and ``NaN * 0.0 = NaN``
+would poison a float matmul. The kernel therefore matmuls on uint16
+HALF-PLANES encoded as f32: each payload word contributes two rows
+(``hi16``, ``lo16`` as exact f32 integers <= 65535); one-hot products and
+single-term sums of such values are exact in f32 (HIGHEST precision), and
+the kernel reassembles ``(hi << 16) | lo`` in int32 before bitcasting
+back. Targets ride the same plane stack as an f32 row (exact below 2^24;
+the builder rejects larger ``m``), and a ones row yields the hit mask.
+
+MEASURED (v5e-class chip, 8.4M-column planar state, 196k updates —
+scripts/microbench_overlay.py): XLA column scatter 17.4 ms; this kernel
+6.7 ms end-to-end including the XLA-side payload sort and plane prep
+(2.6x, W swept 512-8192). In the migrate step (bench.py headline) the
+landing phase drops from 27.5 ms to 12.1 ms in context and the step
+from 44.3 to 36.9 ms; see BENCH_CONFIGS.md.
+
+Contract: ``flat`` f32 planar ``[K, m]`` with ``2 * K + 2 <= ROWS``
+(i.e. K <= 7 at ROWS = 16: pos 3 + vel 3 + alive), ``m`` a multiple of
+``W`` and < 2^24; targets int32, UNIQUE among in-range entries
+(out-of-range = drop sentinel, matching ``mode='drop'``); ``cols`` f32
+``[K, P]``. Falls back to the XLA scatter otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+W = 2048  # lanes per streamed block (swept on-chip: 6.7 ms vs 9.2 at 8192
+#          and 13.5 at 512 — the one-hot compare costs P*W + m*RMAX ops,
+#          so smaller W wins until grid-step overhead takes over)
+RMAX = 128  # update chunk (lane-aligned)
+ROWS = 16  # plane rows per chunk: 2K halves + ones + targets <= ROWS
+
+
+def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
+            acc, sems, *, k: int, w: int, rmax: int):
+    b = pl.program_id(0)
+    base = b * w
+    start = starts_ref[b]
+    end = starts_ref[b + 1]
+    acc[:] = jnp.zeros_like(acc)
+
+    def chunk_body(c, _):
+        j0 = c * rmax
+        dma = pltpu.make_async_copy(
+            planes_hbm.at[:, pl.ds(j0, rmax)], planes_scr, sems.at[0]
+        )
+        dma.start()
+        dma.wait()
+        # targets row -> sublane-major [RMAX, 1] for the lane compare
+        tgt_scr[:] = planes_scr[ROWS - 1 : ROWS, :].T
+        tgt = tgt_scr[:].astype(jnp.int32) - base  # [RMAX, 1]
+        onehot = (
+            tgt
+            == jax.lax.broadcasted_iota(jnp.int32, (rmax, w), 1)
+        ).astype(jnp.float32)
+        # neighbors' and sentinel targets miss every lane: no bounds
+        # masking needed. Unique targets => plain accumulation.
+        acc[:] += jnp.dot(
+            planes_scr[:], onehot,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return _
+
+    c0 = start // rmax
+    c1 = (end + rmax - 1) // rmax
+    jax.lax.fori_loop(c0, c1, chunk_body, None)
+
+    # reassemble 32-bit words from the exact-integer half-planes
+    hi = acc[0:k, :].astype(jnp.int32)
+    lo = acc[k : 2 * k, :].astype(jnp.int32)
+    words = jax.lax.bitcast_convert_type(
+        (hi << 16) | lo, jnp.float32
+    )
+    hit = acc[2 * k : 2 * k + 1, :] > 0.5  # ones-row matmul = hit count
+    out_ref[:] = jnp.where(hit, words[0 : in_ref.shape[0], :], in_ref[:])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "w", "rmax")
+)
+def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX):
+    k, m = flat.shape
+    kernel = functools.partial(_kernel, k=k, w=w, rmax=rmax)
+    return pl.pallas_call(
+        kernel,
+        grid=(m // w,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # starts [T+1]
+            pl.BlockSpec(memory_space=pl.ANY),  # planes [ROWS, P_pad] HBM
+            pl.BlockSpec((k, w), lambda b: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, w), lambda b: (0, b),
+                               memory_space=pltpu.VMEM),
+        # under shard_map the output must declare its varying mesh axes;
+        # mirror the input state's vma (empty outside shard_map)
+        out_shape=jax.ShapeDtypeStruct(
+            (k, m), flat.dtype, vma=jax.typeof(flat).vma
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((ROWS, rmax), jnp.float32),  # planes chunk
+            pltpu.VMEM((rmax, 1), jnp.float32),  # transposed targets
+            pltpu.VMEM((ROWS, w), jnp.float32),  # overlay accumulator
+            pltpu.SemaphoreType.DMA((1,)),
+        ],
+        interpret=interpret,
+    )(starts, planes, flat)
+
+
+def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
+                           rmax=RMAX):
+    """Drop-in for ``flat.at[:, targets].set(cols, mode='drop')``.
+
+    ``flat`` f32 ``[K, m]``; ``targets`` int32 ``[P]`` unique among
+    in-range entries (>= m drops); ``cols`` f32 ``[K, P]``. Falls back to
+    the XLA scatter when the kernel contract doesn't hold (see module
+    docstring).
+    """
+    k, m = flat.shape
+    p = targets.shape[0]
+    if (
+        m % w
+        or m >= (1 << 24)
+        or 2 * k + 2 > ROWS
+        or flat.dtype != jnp.float32
+    ):
+        return flat.at[:, targets].set(cols, mode="drop")
+    sentinel = jnp.int32(m)
+    tgt = jnp.where(
+        (targets < 0) | (targets >= m), sentinel, targets
+    ).astype(jnp.int32)
+    # payload-carrying sort by target (the cheap reorder primitive) on the
+    # RAW f32 rows — bit patterns ride as opaque payload; the exact-f32
+    # half-plane split happens after, elementwise, halving the sort width
+    operands = (tgt,) + tuple(cols[i] for i in range(k))
+    s = jax.lax.sort(operands, num_keys=1, is_stable=False)
+    ts = s[0]
+    words = jax.lax.bitcast_convert_type(
+        jnp.stack(s[1:], axis=0), jnp.uint32
+    )
+    hi = (words >> 16).astype(jnp.float32)  # exact: <= 65535
+    lo = (words & 0xFFFF).astype(jnp.float32)
+    p_pad = max(-(-p // rmax) * rmax, rmax)
+    pad = p_pad - p
+
+    def padk(a, fill):
+        return jnp.pad(a, ((0, 0), (0, pad)), constant_values=fill)
+
+    planes = jnp.concatenate(
+        [
+            padk(hi, 0.0),
+            padk(lo, 0.0),
+            padk(jnp.ones((1, p), jnp.float32), 0.0),  # hit-count row
+            jnp.zeros((ROWS - 2 * k - 2, p_pad), jnp.float32),
+            # targets row, LAST (the kernel reads ROWS-1; exact: m < 2^24)
+            padk(ts.astype(jnp.float32)[None, :], float(m)),
+        ],
+        axis=0,
+    )
+    edges = jnp.arange(0, m + w, w, dtype=jnp.int32)
+    starts = jnp.searchsorted(
+        ts, edges, side="left", method="sort"
+    ).astype(jnp.int32)
+    return _overlay_sorted(
+        flat, starts, planes, interpret=interpret, w=w, rmax=rmax
+    )

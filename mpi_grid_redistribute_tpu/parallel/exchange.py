@@ -218,8 +218,6 @@ def vrank_redistribute_planar_fn(
     row — validity here is the count prefix, as everywhere on the
     canonical path).
     """
-    from mpi_grid_redistribute_tpu.parallel.migrate import _pack_cols
-
     V = grid.nranks
     C = capacity
     D = domain.ndim if ndim is None else ndim
@@ -246,7 +244,7 @@ def vrank_redistribute_planar_fn(
             )
             dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
             send_counts = jnp.minimum(remote_counts, C)
-            packed, _ = _pack_cols(
+            packed, _ = pack.pack_cols(
                 f_v, order, bounds[:V], send_counts, V, C
             )  # [K, V*C]
             needed = jnp.max(remote_counts).astype(jnp.int32)

@@ -84,32 +84,59 @@ from jax import lax
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.ops.pack import pack_cols as _pack_cols
 
 
-def _resolve_pallas_scatter(pallas_scatter) -> bool:
+def _resolve_scatter_impl(scatter_impl) -> str:
     """Resolve the landing-scatter implementation choice at BUILD time.
 
-    ``None`` (the default) consults MPI_GRID_PALLAS_SCATTER=1 once, when the
-    builder runs — not inside the traced function, where jit caching (keyed
-    on shapes only) would freeze the first value seen and make later env
-    changes silently ineffective (round-2 advisor). Passing an explicit
-    bool overrides the env entirely, so two settings can coexist in one
+    Returns one of ``"overlay"`` (default on TPU: the planar one-hot
+    overlay kernel, ops/pallas_overlay — measured 2.6x the XLA scatter at
+    bench shapes), ``"xla"``, or ``"rows"`` (the round-2 row-store kernel,
+    ops/pallas_scatter — a documented negative result kept for its
+    platform findings).
+
+    ``None`` (the default) consults the env once, when the builder runs —
+    not inside the traced function, where jit caching (keyed on shapes
+    only) would freeze the first value seen and make later env changes
+    silently ineffective (round-2 advisor). MPI_GRID_LAND_SCATTER
+    ∈ {overlay, xla, rows} picks explicitly; legacy
+    MPI_GRID_PALLAS_SCATTER=1 still selects "rows". Passing an explicit
+    value overrides the env entirely, so two settings can coexist in one
     process via two builders."""
-    if pallas_scatter is None:
-        pallas_scatter = os.environ.get("MPI_GRID_PALLAS_SCATTER") == "1"
-    return bool(pallas_scatter) and (
-        jax.devices()[0].platform in ("tpu", "axon")
-    )
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if scatter_impl is None:
+        env = os.environ.get("MPI_GRID_LAND_SCATTER")
+        if env is None and os.environ.get("MPI_GRID_PALLAS_SCATTER") == "1":
+            env = "rows"
+        impl = env or ("overlay" if on_tpu else "xla")
+    elif scatter_impl is True:
+        impl = "rows"
+    elif scatter_impl is False:
+        impl = "xla"
+    else:
+        impl = str(scatter_impl)
+    if impl not in ("overlay", "xla", "rows"):
+        raise ValueError(f"unknown landing-scatter impl {impl!r}")
+    return impl if on_tpu else "xla"
 
 
-def _land_scatter(flat, targets, cols, use_pallas: bool = False):
-    """The landing column-scatter on planar ``[K, m]`` state; ``use_pallas``
-    selects the Pallas streamed-overlay kernel (ops/pallas_scatter, a
-    documented negative result kept for its platform findings) — resolved
-    by the builder via :func:`_resolve_pallas_scatter`, never read from the
-    env here. The Pallas kernel takes row-major buffers, so that branch
+def _land_scatter(flat, targets, cols, impl: str = "xla"):
+    """The landing column-scatter on planar ``[K, m]`` state.
+
+    ``impl`` is resolved by the builder via :func:`_resolve_scatter_impl`,
+    never read from the env here. ``"overlay"`` is the planar one-hot
+    overlay kernel (sort arrivals by target, stream the state through
+    VMEM, place via MXU one-hot matmuls — no per-element stores; it
+    falls back to the XLA scatter itself when its contract doesn't
+    hold). ``"rows"`` is the round-2 per-row-store kernel, kept for its
+    measured negative result; it takes row-major buffers, so that branch
     pays two transposes on top of its already-losing per-row stores."""
-    if use_pallas:
+    if impl == "overlay":
+        from mpi_grid_redistribute_tpu.ops import pallas_overlay
+
+        return pallas_overlay.overlay_scatter_planar(flat, targets, cols)
+    if impl == "rows":
         from mpi_grid_redistribute_tpu.ops import pallas_scatter
 
         return pallas_scatter.scatter_rows(flat.T, targets, cols.T).T
@@ -307,27 +334,6 @@ def _cycle_rescue(pending, sends_zero, ok=None):
         cycle_bad = jnp.any(mutual & ~ok[None, :], axis=1)
         on_cycle = on_cycle & ~cycle_bad
     return (A * on_cycle[:, None]).astype(jnp.int32)
-
-
-def _pack_cols(fused, order, bounds, send_counts, n_dest: int,
-               capacity: int):
-    """Gather the first ``send_counts[d]`` sorted columns of each
-    destination segment into a ``[K, n_dest * C]`` send pool (zero in
-    invalid slots). Returns ``(send, gather_idx)``; ``gather_idx[j]`` is
-    the resident column feeding send slot ``j`` (unique over valid
-    slots)."""
-    n = fused.shape[1]
-    C = capacity
-    c_idx = jnp.arange(C, dtype=jnp.int32)
-    flat_c = jnp.tile(c_idx, n_dest)
-    flat_d = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), C)
-    slot_valid = flat_c < send_counts[flat_d]
-    src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
-    gather_idx = order[src]  # [n_dest*C] unique over valid slots
-    send = jnp.where(
-        slot_valid[None, :], jnp.take(fused, gather_idx, axis=1), 0.0
-    )
-    return send, gather_idx
 
 
 def _stack_push_pop(free_stack, n_free, n_pop, n_push, vacated, n_in):
@@ -624,7 +630,7 @@ def shard_migrate_vranks_fn(
     capacity: int,
     ndim: int = None,
     local_budget: int = None,
-    pallas_scatter: bool = None,
+    scatter_impl=None,  # None | "overlay" | "xla" | "rows" | bool
     cycle_rescue: bool = True,
     cells: ProcessGrid = None,
     assignment: tuple = None,
@@ -668,7 +674,12 @@ def shard_migrate_vranks_fn(
     landing scatter's cost scales with this PLAN length, not with actual
     migrants, so size it to a few x the expected per-step migration;
     ``capacity`` bounds cross-device migrants per (source vrank,
-    destination vrank) pair.
+    destination vrank) pair. ``scatter_impl`` selects the landing-scatter
+    implementation: ``None`` (env / platform default — "overlay" on TPU),
+    ``"overlay"`` (planar one-hot overlay kernel), ``"xla"``, or
+    ``"rows"`` (round-2 per-row-store kernel, a kept negative result);
+    bools are accepted for backward compatibility (True = "rows",
+    False = "xla"). See :func:`_resolve_scatter_impl`.
 
     **Load-balanced assignment** (``cells`` + ``assignment``): by default a
     vrank IS a spatial subdomain of the ``dev_grid * vgrid`` product grid —
@@ -714,7 +725,7 @@ def shard_migrate_vranks_fn(
     # static plan lengths: most rows a vrank can send / receive in a step
     S_max = M + ((Dev - 1) * V * C if Dev > 1 else 0)
     P = max(M, S_max)
-    use_pallas = _resolve_pallas_scatter(pallas_scatter)
+    scatter_impl = _resolve_scatter_impl(scatter_impl)
 
     def fn(state: MigrateState):
         flat, free_stack, n_free = state  # [K, V*n], [V, n], [V]
@@ -993,7 +1004,7 @@ def shard_migrate_vranks_fn(
         )
         flat = _land_scatter(
             flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
-            use_pallas,
+            scatter_impl,
         )
 
         # ---- free-stack update (contiguous window blend) --------------

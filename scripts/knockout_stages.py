@@ -190,15 +190,17 @@ def truncated_step(domain, vgrid, C, M, n, phase):
         if phase == 6:
             return dep_out(gtargets)
 
-        # ---- 7: landing scatter (planar columns) ------------------------
+        # ---- 7: landing scatter (planar columns; the shipped impl —
+        # "overlay" by default on TPU, override MPI_GRID_LAND_SCATTER) ----
         cols_w = jnp.zeros((K, V, P), flat.dtype).at[:, :, :M].set(
             arr_cols
         )
         cols_w = jnp.where(
             (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0.0
         )
-        flat2 = flat.at[:, gtargets.reshape(-1)].set(
-            cols_w.reshape(K, V * P), mode="drop"
+        flat2 = migrate._land_scatter(
+            flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
+            migrate._resolve_scatter_impl(None),
         )
         if phase == 7:
             return migrate.MigrateState(flat2, free_stack, n_free)

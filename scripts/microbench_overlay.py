@@ -1,0 +1,84 @@
+"""On-chip: planar one-hot overlay scatter vs XLA column scatter.
+
+Shapes mirror the bench.py headline landing: [7, 8.4M] planar state,
+~196k updates (the landing plan length at 2% migration, 8 vranks x 1M).
+Both timed with the scan-differencing harness; bit-equality asserted
+against the XLA scatter first (including NaN-bit payload rows).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.ops import pallas_overlay
+from mpi_grid_redistribute_tpu.utils import profiling
+
+K = 7
+M = 8 * (1 << 20)  # 8.4M columns
+P = 196_608  # landing-plan entries
+
+
+def main():
+    r = np.random.default_rng(0)
+    flat = r.standard_normal((K, M)).astype(np.float32)
+    flat[6] = r.integers(-(2**31), 2**31 - 1, size=M, dtype=np.int32).view(
+        np.float32
+    )
+    targets = r.choice(M, size=P, replace=False).astype(np.int32)
+    # ~7% drop sentinels like a real plan's padding tail
+    targets[r.random(P) < 0.07] = M
+    cols = r.standard_normal((K, P)).astype(np.float32)
+    cols[6] = r.integers(-(2**31), 2**31 - 1, size=P, dtype=np.int32).view(
+        np.float32
+    )
+
+    fd, td, cd = (
+        jax.device_put(jnp.asarray(flat)),
+        jax.device_put(jnp.asarray(targets)),
+        jax.device_put(jnp.asarray(cols)),
+    )
+
+    out_k = pallas_overlay.overlay_scatter_planar(fd, td, cd)
+    out_x = fd.at[:, td].set(cd, mode="drop")
+    a = np.asarray(out_k).view(np.uint32)
+    b = np.asarray(out_x).view(np.uint32)
+    assert np.array_equal(a, b), (
+        f"bit mismatch: {np.sum(a != b)} of {a.size}"
+    )
+    print("bit-equality vs XLA scatter: OK", flush=True)
+
+    def time_impl(impl):
+        def make_loop(S):
+            @jax.jit
+            def loop(f, t, c):
+                def body(acc, _):
+                    o = impl(f + acc * jnp.float32(1e-30), t, c)
+                    return acc + o[0, 0], None
+                out, _ = lax.scan(body, jnp.float32(0), None, length=S)
+                return out
+            return loop
+        per, _, _ = profiling.scan_time_per_step(
+            make_loop, (fd, td, cd), s1=2, s2=10
+        )
+        return per
+
+    t_x = time_impl(
+        lambda f, t, c: f.at[:, t].set(c, mode="drop")
+    )
+    print(f"XLA column scatter: {t_x*1e3:.2f} ms", flush=True)
+    import functools
+    for w in (512, 1024, 2048):
+        t_k = time_impl(functools.partial(
+            pallas_overlay.overlay_scatter_planar, w=w))
+        print(f"overlay kernel W={w} (incl. sort+prep): {t_k*1e3:.2f} ms "
+              f"({t_x/t_k:.1f}x)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ from jax import lax
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning, pack as pack_lib
 from mpi_grid_redistribute_tpu.parallel import exchange
-from mpi_grid_redistribute_tpu.parallel.migrate import _pack_cols
+from mpi_grid_redistribute_tpu.ops.pack import pack_cols as _pack_cols
 from mpi_grid_redistribute_tpu.utils import profiling
 
 V = 8
