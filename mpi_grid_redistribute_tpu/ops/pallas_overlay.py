@@ -27,8 +27,10 @@ HALF-PLANES encoded as f32: each payload word contributes two rows
 (``hi16``, ``lo16`` as exact f32 integers <= 65535); one-hot products and
 single-term sums of such values are exact in f32 (HIGHEST precision), and
 the kernel reassembles ``(hi << 16) | lo`` in int32 before bitcasting
-back. Targets ride the same plane stack as an f32 row (exact below 2^24;
-the builder rejects larger ``m``), and a ones row yields the hit mask.
+back. Targets ride the same plane stack bitcast as ``int + 0x3F800000``
+— a raw int bitcast is a denormal f32 below 2^23 and TPU vector copies
+flush denormals to zero (measured); the bias keeps every pattern a
+normal float for any ``m < 2^30`` — and a ones row yields the hit mask.
 
 MEASURED (v5e-class chip, 8.4M-column planar state, 196k updates —
 scripts/microbench_overlay.py): XLA column scatter 17.4 ms; this kernel
@@ -39,7 +41,7 @@ from 44.3 to 36.9 ms; see BENCH_CONFIGS.md.
 
 Contract: ``flat`` f32 planar ``[K, m]`` with ``2 * K + 2 <= ROWS``
 (i.e. K <= 7 at ROWS = 16: pos 3 + vel 3 + alive), ``m`` a multiple of
-``W`` and < 2^24; targets int32, UNIQUE among in-range entries
+``W``; targets int32, UNIQUE among in-range entries
 (out-of-range = drop sentinel, matching ``mode='drop'``); ``cols`` f32
 ``[K, P]``. Falls back to the XLA scatter otherwise.
 """
@@ -53,9 +55,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-W = 2048  # lanes per streamed block (swept on-chip: 6.7 ms vs 9.2 at 8192
-#          and 13.5 at 512 — the one-hot compare costs P*W + m*RMAX ops,
-#          so smaller W wins until grid-step overhead takes over)
+W = 2048  # lanes per streamed block (swept on-chip with the bias-encoded
+#          targets: 2.14 ms at 2048 vs 8.6 at 1024 and 14.0 at 512 at
+#          bench shapes — the one-hot compare costs P*W + m*RMAX ops and
+#          below 2048 grid-step overhead dominates)
 RMAX = 128  # update chunk (lane-aligned)
 ROWS = 16  # plane rows per chunk: 2K halves + ones + targets <= ROWS
 
@@ -75,9 +78,18 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
         )
         dma.start()
         dma.wait()
-        # targets row -> sublane-major [RMAX, 1] for the lane compare
+        # targets row -> sublane-major [RMAX, 1] for the lane compare;
+        # targets travel as bitcast (int + 0x3F800000) patterns: a raw
+        # int bitcast is a DENORMAL f32 for targets < 2^23 and the TPU
+        # vector units flush denormals to zero on any copy (measured:
+        # 1.28M corrupted targets of 58.7M at the first on-chip run);
+        # the bias keeps every pattern a normal float for ints < 2^30
         tgt_scr[:] = planes_scr[ROWS - 1 : ROWS, :].T
-        tgt = tgt_scr[:].astype(jnp.int32) - base  # [RMAX, 1]
+        tgt = (
+            jax.lax.bitcast_convert_type(tgt_scr[:], jnp.int32)
+            - jnp.int32(0x3F800000)
+            - base
+        )  # [RMAX, 1]
         onehot = (
             tgt
             == jax.lax.broadcasted_iota(jnp.int32, (rmax, w), 1)
@@ -150,7 +162,7 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
     p = targets.shape[0]
     if (
         m % w
-        or m >= (1 << 24)
+        or m >= (1 << 30)  # target encoding bound (never denormal/NaN)
         or 2 * k + 2 > ROWS
         or flat.dtype != jnp.float32
     ):
@@ -176,14 +188,21 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
     def padk(a, fill):
         return jnp.pad(a, ((0, 0), (0, pad)), constant_values=fill)
 
+    # targets travel bitcast with the +0x3F800000 bias (normal-float
+    # patterns only — see module docstring / kernel comment)
+    bias = jnp.int32(0x3F800000)
+    ts_bits = jax.lax.bitcast_convert_type(ts + bias, jnp.float32)
+    sent_bits = jax.lax.bitcast_convert_type(sentinel + bias, jnp.float32)
     planes = jnp.concatenate(
         [
             padk(hi, 0.0),
             padk(lo, 0.0),
             padk(jnp.ones((1, p), jnp.float32), 0.0),  # hit-count row
             jnp.zeros((ROWS - 2 * k - 2, p_pad), jnp.float32),
-            # targets row, LAST (the kernel reads ROWS-1; exact: m < 2^24)
-            padk(ts.astype(jnp.float32)[None, :], float(m)),
+            # targets row, LAST (the kernel reads ROWS-1)
+            jnp.concatenate(
+                [ts_bits, jnp.full((pad,), sent_bits, jnp.float32)]
+            )[None, :],
         ],
         axis=0,
     )

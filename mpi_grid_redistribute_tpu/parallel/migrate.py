@@ -369,6 +369,7 @@ def _land_arrivals(
     send_counts,
     gather_idx,
     capacity: int,
+    scatter_impl: str = "xla",
 ):
     """Land compacted arrivals into vacated slots, then popped holes.
 
@@ -424,7 +425,7 @@ def _land_arrivals(
     )
     cols = jnp.where((k_idx < n_in)[None, :], arrivals, 0.0)
     # THE scatter: payload + alive flag + hole markers in one pass.
-    fused = fused.at[:, target].set(cols, mode="drop")
+    fused = _land_scatter(fused, target, cols, scatter_impl)
 
     # Free-stack update: net excess departures (n_sent - n_in when
     # positive) were written as holes at vacated[n_in : n_sent]: push them.
@@ -437,7 +438,7 @@ def _land_arrivals(
 
 def shard_migrate_fused_fn(
     domain: Domain, grid: ProcessGrid, capacity: int, ndim: int = None,
-    cycle_rescue: bool = True,
+    cycle_rescue: bool = True, scatter_impl=None,
 ):
     """Per-shard migration on planar fused state (runs under ``shard_map``).
 
@@ -457,6 +458,7 @@ def shard_migrate_fused_fn(
     C = capacity
     D = domain.ndim if ndim is None else ndim
     rescue = cycle_rescue and R <= 128
+    impl = _resolve_scatter_impl(scatter_impl)
 
     def fn(state: MigrateState):
         fused, free_stack, n_free = state
@@ -542,7 +544,7 @@ def shard_migrate_fused_fn(
 
         fused, free_stack, n_free, n_in, dropped_recv = _land_arrivals(
             fused, free_stack, n_free, recv, recv_counts, send_counts,
-            gather_idx, C,
+            gather_idx, C, impl,
         )
         population = jnp.sum((fused[-1, :] > 0.5).astype(jnp.int32))
         stats = MigrateStats(

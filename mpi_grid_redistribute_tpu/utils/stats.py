@@ -61,7 +61,9 @@ def check_no_loss(stats) -> None:
     """Raise if any surfaced *loss* counter is nonzero.
 
     ``backlog`` is intentionally not treated as loss: backlogged migrants
-    stay resident and retry next step (retry-not-loss by design).
+    stay resident and retry next step (retry-not-loss by design). A
+    backlog that never drains is a *liveness* concern instead — check it
+    with :func:`detect_stall` on step-stacked stats.
     """
     problems = []
     for name in ("dropped_send", "dropped_recv"):
@@ -74,3 +76,31 @@ def check_no_loss(stats) -> None:
             "particle loss detected: " + ", ".join(problems)
             + " — raise capacity / out_capacity / slab headroom"
         )
+
+
+def detect_stall(stats, window: int = 8) -> Dict[str, float]:
+    """Flag a migration stall: constant nonzero backlog over a window.
+
+    ``backlog`` is retry-not-loss, so :func:`check_no_loss` deliberately
+    ignores it — but a backlog that never drains is a liveness problem
+    worth surfacing (round-2 advisor). Single-device rotation cycles are
+    rescued automatically (``migrate._cycle_rescue``); the remaining
+    reachable stall is a mutually-full cycle SPANNING devices on the
+    vrank path (no cross-device swap financing; any hole on the cycle
+    drains it — see parallel/migrate.py docstring).
+
+    Pass a step-stacked ``MigrateStats`` (``loop(...)`` output, leaves
+    ``[S, R]``). Returns a dict with ``stalled`` (1.0/0.0 — True when the
+    final ``window`` steps all have the same nonzero total backlog),
+    ``backlog_final``, ``backlog_min``/``backlog_max`` over the window.
+    """
+    backlog = np.asarray(stats.backlog)
+    per_step = backlog.reshape(backlog.shape[0], -1).sum(axis=1)
+    win = per_step[-min(window, len(per_step)):]
+    stalled = bool(len(win) >= window and win.min() == win.max() > 0)
+    return {
+        "stalled": float(stalled),
+        "backlog_final": int(per_step[-1]),
+        "backlog_min": int(win.min()),
+        "backlog_max": int(win.max()),
+    }

@@ -150,3 +150,28 @@ def test_exchange_bw_util():
     assert abs(util - 0.25) < 1e-12
     with pytest.raises(ValueError):
         profiling.exchange_peak_bytes_per_sec("dcn")
+
+
+def test_detect_stall():
+    from mpi_grid_redistribute_tpu.parallel.migrate import MigrateStats
+
+    def mk(backlogs):
+        S = len(backlogs)
+        z = np.zeros((S, 4), np.int32)
+        b = np.zeros((S, 4), np.int32)
+        b[:, 0] = backlogs
+        return MigrateStats(sent=z, received=z, population=z, backlog=b,
+                            dropped_recv=z)
+
+    # constant nonzero backlog over the window -> stall
+    r = stats.detect_stall(mk([0, 0, 3, 3, 3, 3]), window=4)
+    assert r["stalled"] == 1.0 and r["backlog_final"] == 3
+    # draining backlog -> no stall
+    r = stats.detect_stall(mk([5, 4, 3, 2, 1, 0]), window=4)
+    assert r["stalled"] == 0.0
+    # zero backlog -> no stall
+    r = stats.detect_stall(mk([0] * 6), window=4)
+    assert r["stalled"] == 0.0
+    # too-short history -> not flagged
+    r = stats.detect_stall(mk([7, 7]), window=4)
+    assert r["stalled"] == 0.0
