@@ -13,6 +13,8 @@ code* — semantic drift between backend and oracle is structurally impossible.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,15 +22,69 @@ import numpy as np
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 
 
+def _is_pow2(x: float) -> bool:
+    """True for positive powers of two (reciprocal exactly representable)."""
+    if x <= 0 or not math.isfinite(x):
+        return False
+    mant, _ = math.frexp(x)
+    return mant == 0.5
+
+
+def remainder_fast(q, ext: float, xp=jnp):
+    """``remainder(q, ext)`` with a reciprocal-multiply fast path.
+
+    f32 division is the cost of ``remainder`` on the TPU VPU: the binning
+    chain measured 6.9 ms with ``jnp.remainder`` vs 1.75 ms with
+    ``q - floor(q * (1/ext)) * ext`` at 8.4M rows
+    (scripts/microbench_leaver_compact.py). For power-of-two extents the
+    two are BIT-EQUAL (1/ext, the scale and the final subtraction are all
+    exact — IEEE remainder by an exact-reciprocal divisor), so the fast
+    path preserves the engines' bit-compatibility with the NumPy oracle,
+    which is why it only engages when exactness is guaranteed.
+
+    One non-exact corner is handled explicitly: when ``|q|`` is tiny
+    enough that ``q * (1/ext)`` is denormal, a flush-to-zero backend (TPU
+    vector units; some CPU fast-math paths) makes the raw fast path
+    return a tiny NEGATIVE value, while a denormal-honoring backend
+    returns a value that rounds to exactly ``ext``. The two-sided fold
+    below lands every backend on the same bits — the fast path's result
+    is GUARANTEED in ``[0, ext)`` (unlike ``remainder``, whose
+    rounds-to-ext corner callers must fold) — and it also totalizes the
+    +/-inf products of absurd inputs identically everywhere.
+    """
+    if _is_pow2(float(ext)):
+        dt = q.dtype.type
+        r = q - xp.floor(q * dt(1.0 / ext)) * dt(ext)
+        return xp.where((r < dt(0)) | (r >= dt(ext)), dt(0), r)
+    return xp.remainder(q, xp.asarray(ext, dtype=q.dtype))
+
+
 def wrap_periodic(pos, domain: Domain, xp=jnp):
     """Wrap positions into [lo, hi) along the domain's periodic axes.
 
     Non-periodic axes pass through unchanged (out-of-box particles on those
-    axes are clamped into edge cells by ``cell_of_position``).
+    axes are clamped into edge cells by ``cell_of_position``). Power-of-two
+    extents take the exact reciprocal-multiply path (:func:`remainder_fast`).
     """
     lo = xp.asarray(domain.lo, dtype=pos.dtype)
     extent = xp.asarray(domain.extent, dtype=pos.dtype)
-    wrapped = lo + xp.remainder(pos - lo, extent)
+    q = pos - lo
+    # fast path gates on the PERIODIC axes only (non-periodic axes'
+    # wrap result is discarded by the final where)
+    if all(
+        _is_pow2(float(e))
+        for e, p in zip(domain.extent, domain.periodic)
+        if p
+    ):
+        inv = xp.asarray(
+            [1.0 / e if _is_pow2(float(e)) else 0.0 for e in domain.extent],
+            dtype=pos.dtype,
+        )
+        r = q - xp.floor(q * inv) * extent
+        # denormal-product FTZ fold: see remainder_fast
+        wrapped = lo + xp.where(r < 0, xp.zeros_like(r), r)
+    else:
+        wrapped = lo + xp.remainder(q, extent)
     # remainder can round up to exactly `extent` for tiny negative inputs in
     # float32; fold that back to lo.
     wrapped = xp.where(wrapped >= lo + extent, lo, wrapped)
@@ -79,7 +135,7 @@ def wrap_periodic_planar(pos, domain: Domain, xp=jnp):
         if domain.periodic[d]:
             lo = xp.asarray(domain.lo[d], dtype=pos.dtype)
             ext = xp.asarray(domain.extent[d], dtype=pos.dtype)
-            w = lo + xp.remainder(p - lo, ext)
+            w = lo + remainder_fast(p - lo, domain.extent[d], xp=xp)
             w = xp.where(w >= lo + ext, lo, w)
             out.append(w)
         else:

@@ -70,7 +70,7 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             lo = jnp.asarray(domain.lo[d], p.dtype)
             ext = jnp.asarray(domain.extent[d], p.dtype)
             if domain.periodic[d]:
-                p = lo + jnp.remainder(p - lo, ext)
+                p = lo + binning.remainder_fast(p - lo, domain.extent[d])
                 p = jnp.where(p >= lo + ext, lo, p)
             inv_w = jnp.asarray(vgrid.shape[d], p.dtype) / ext
             cell_d = jnp.clip(

@@ -1,0 +1,180 @@
+"""On-chip costs of the leaver-compaction alternative to the full key sort.
+
+The migrate step's phase 2 stable-sorts ALL [V, n] rows by destination
+(10.3 ms at 8 x 1M) although only ~2% are leavers. The alternative:
+
+  a. leaving mask + per-vrank exclusive cumsum (elementwise + prefix);
+  b. compact the ~196k leaver slot ids into [V, M] via a scatter whose
+     targets are the cumsum ranks — monotone, so the overlay kernel needs
+     no prep sort (or XLA scatter for comparison);
+  c. sort the COMPACT leavers by destination ([V, M] 2-operand);
+  d. gather their dest keys/columns (1-row gathers, plan-sized).
+
+This script measures each piece so the refactor decision is numbers-led.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.utils import profiling
+
+V, n = 8, 1 << 20
+M = 24576  # per-vrank leaver budget (bench local_budget)
+R_total = 8
+
+
+def time_fn(fn, *args, s1=2, s2=10):
+    def make_loop(S):
+        @jax.jit
+        def loop(*a):
+            def body(acc, _):
+                out = fn(*jax.tree.map(
+                    lambda x: x + (acc * jnp.float32(1e-30)).astype(x.dtype),
+                    a,
+                ))
+                leaf = jax.tree.leaves(out)[0]
+                return acc + leaf.ravel()[0].astype(jnp.float32), None
+            out, _ = lax.scan(body, jnp.float32(0), None, length=S)
+            return out
+        return loop
+    per, _, _ = profiling.scan_time_per_step(make_loop, args, s1=s1, s2=s2)
+    return per
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dest = rng.integers(0, R_total + 1, size=(V, n)).astype(np.int32)
+    # ~2% leavers (dest != sentinel), like the bench step
+    leaving = rng.random((V, n)) < 0.02
+    dest = np.where(leaving, dest % R_total, R_total).astype(np.int32)
+    dest_d = jax.device_put(jnp.asarray(dest))
+
+    # 0) the incumbent: full stable key sort + counts
+    t = time_fn(
+        lambda d: jax.vmap(
+            lambda k: binning.sorted_dest_counts(k, R_total)
+        )(d)[0],
+        dest_d,
+    )
+    print(f"incumbent full sort [V,n]: {t*1e3:.2f} ms", flush=True)
+
+    # a) mask + per-vrank exclusive cumsum (int32)
+    def cumsum_rank(d):
+        leave = (d < R_total).astype(jnp.int32)
+        return jnp.cumsum(leave, axis=1) - leave  # exclusive
+
+    t = time_fn(cumsum_rank, dest_d)
+    print(f"mask + cumsum [V,n]: {t*1e3:.2f} ms", flush=True)
+
+    # b1) compact via XLA scatter (targets = vrank_off + rank, values=idx)
+    def compact_xla(d):
+        leave = d < R_total
+        rank = jnp.cumsum(leave.astype(jnp.int32), axis=1) - 1
+        off = jnp.arange(V, dtype=jnp.int32)[:, None] * M
+        tgt = jnp.where(leave & (rank < M), off + rank, V * M)
+        idx = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[None, :], (V, n)
+        )
+        buf = jnp.zeros((V * M,), jnp.int32)
+        return buf.at[tgt.reshape(-1)].set(
+            idx.reshape(-1), mode="drop"
+        )
+
+    t = time_fn(compact_xla, dest_d)
+    print(f"compact via XLA scatter (8.4M scatter ops!): {t*1e3:.2f} ms",
+          flush=True)
+
+    # b2) compact via one sort of (rank-with-sentinel) — what the overlay
+    # kernel's presorted path would replace; measures the sort floor
+    def compact_sort(d):
+        leave = d < R_total
+        key = jnp.where(leave, d, R_total)
+        order, counts, bounds = jax.vmap(
+            lambda k: binning.sorted_dest_counts(k, R_total)
+        )(key)
+        return order[:, :M]
+
+    # c) small sort of the compact leavers by dest
+    comp_dest = rng.integers(0, R_total, size=(V, M)).astype(np.int32)
+    t = time_fn(
+        lambda d: jax.vmap(
+            lambda k: binning.sorted_dest_counts(k, R_total)
+        )(d)[0],
+        jax.device_put(jnp.asarray(comp_dest)),
+    )
+    print(f"small sort [V,M={M}]: {t*1e3:.2f} ms", flush=True)
+
+    # d) 1-row gather of plan-sized ids from [V*n]
+    flat_ids = jax.device_put(
+        jnp.asarray(rng.integers(0, 100, size=(V * n,)).astype(np.int32))
+    )
+    gidx = jax.device_put(
+        jnp.asarray(rng.integers(0, V * n, size=(V * M,)).astype(np.int32))
+    )
+    t = time_fn(lambda f, g: jnp.take(f, g, axis=0), flat_ids, gidx)
+    print(f"1-row gather of {V*M} ids: {t*1e3:.2f} ms", flush=True)
+
+
+def bench_bin_variants():
+    """Phase-1 attack: is the binning chain division-bound? Compare the
+    remainder-based wrap against a reciprocal-multiply variant (exact for
+    power-of-two extents: remainder(q, ext) == q - floor(q * (1/ext)) *
+    ext bit-for-bit when 1/ext is exact)."""
+    rng = np.random.default_rng(1)
+    m = V * n
+    flat = jax.device_put(
+        jnp.asarray(rng.standard_normal((7, m)).astype(np.float32))
+    )
+    shape = (2, 2, 2)
+    strides = (4, 2, 1)
+
+    def bin_current(f):
+        dest = jnp.zeros((m,), jnp.int32)
+        for d in range(3):
+            p = f[d, :]
+            lo = jnp.float32(0.0)
+            ext = jnp.float32(1.0)
+            p = lo + jnp.remainder(p - lo, ext)
+            p = jnp.where(p >= lo + ext, lo, p)
+            inv_w = jnp.float32(shape[d] / 1.0)
+            cell = jnp.clip(
+                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                0, shape[d] - 1,
+            )
+            dest = dest + cell * jnp.int32(strides[d])
+        return dest
+
+    def bin_recip(f):
+        dest = jnp.zeros((m,), jnp.int32)
+        for d in range(3):
+            q = f[d, :] - jnp.float32(0.0)
+            # ext = 1.0 (power of two): reciprocal-multiply wrap, exact
+            q = q - jnp.floor(q * jnp.float32(1.0)) * jnp.float32(1.0)
+            q = jnp.where(q >= jnp.float32(1.0), jnp.float32(0.0), q)
+            cell = jnp.clip(
+                jnp.floor(q * jnp.float32(shape[d])).astype(jnp.int32),
+                0, shape[d] - 1,
+            )
+            dest = dest + cell * jnp.int32(strides[d])
+        return dest
+
+    a = np.asarray(jax.jit(bin_current)(flat))
+    b = np.asarray(jax.jit(bin_recip)(flat))
+    print(f"bin variants bit-equal: {np.array_equal(a, b)}", flush=True)
+    t = time_fn(bin_current, flat)
+    print(f"bin with jnp.remainder: {t*1e3:.2f} ms", flush=True)
+    t = time_fn(bin_recip, flat)
+    print(f"bin with reciprocal-mul wrap: {t*1e3:.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    bench_bin_variants()

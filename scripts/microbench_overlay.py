@@ -73,7 +73,7 @@ def main():
     )
     print(f"XLA column scatter: {t_x*1e3:.2f} ms", flush=True)
     import functools
-    for w in (2048, 4096, 8192):
+    for w in (512, 1024, 2048, 4096, 8192):
         t_k = time_impl(functools.partial(
             pallas_overlay.overlay_scatter_planar, w=w))
         print(f"overlay kernel W={w} (incl. sort+prep): {t_k*1e3:.2f} ms "

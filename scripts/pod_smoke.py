@@ -2,7 +2,7 @@
 
 RISK NOTE (round-2 verdict, missing item 6): in the build environment only
 ONE physical TPU chip is reachable, so ``lax.all_to_all`` / ``ppermute``
-have executed on real ICI only never — every multi-device proof ran on
+have NEVER executed on real ICI here — every multi-device proof ran on
 XLA's virtual CPU mesh (tests/conftest.py, ``dryrun_multichip``) or as the
 single-device vrank transpose twin (bit-identical semantics, HBM-side).
 SURVEY.md §7.6 named "all_to_all lowers and runs on >= 2 real chips" the
