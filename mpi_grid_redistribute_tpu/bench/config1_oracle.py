@@ -160,10 +160,54 @@ def run(n_total: int = None, reps: int = 3) -> dict:
     assert int(np.asarray(long_p[2]).sum()) == 0, "planar loop lost rows"
     assert int(np.asarray(long_p[1]).sum()) == vR * n_loc
 
+    # THROUGH the public entry point (VERDICT round-3 item 1 done
+    # criterion): the same steady-state drift loop, but every exchange is
+    # a real `GridRedistribute.redistribute()` call — engine='auto' routes
+    # the planar [K, n] payload-sort engine, and each call's inputs are
+    # the previous call's device outputs, so dispatch pipelines and only
+    # the final fetch blocks. This prices the full public path: boundary
+    # fuse/unfuse transposes + one jitted planar exchange per call.
+    rd_api = GridRedistribute(
+        lo=0.0, hi=1.0, periodic=True, grid=(2, 2, 2),
+        capacity=cap, out_capacity=slots, on_overflow="ignore",
+    )
+    drift = jax.jit(
+        lambda p, v: binning.wrap_periodic(p + v * jnp.float32(1.0), domain)
+    )
+    api_steps = 24
+    warm = 4
+
+    def api_loop(steps, res, vel_a):
+        for _ in range(steps):
+            p = drift(res.positions, vel_a)
+            res = rd_api.redistribute(p, vel_a, count=res.count)
+            vel_a = res.fields[0]
+        jax.block_until_ready(res.positions)
+        return res, vel_a
+
+    res_a = rd_api.redistribute(
+        jnp.asarray(posv.reshape(vR * slots, 3)),
+        jnp.asarray(velv.reshape(vR * slots, 3)),
+        count=jnp.asarray(countv),
+    )
+    res_a, vel_a = api_loop(warm, res_a, res_a.fields[0])  # warm the jits
+    t0 = time.perf_counter()
+    res_a, vel_a = api_loop(api_steps, res_a, vel_a)
+    api_per_step = (time.perf_counter() - t0) / api_steps
+    assert int(np.asarray(res_a.count).sum()) == vR * n_loc, (
+        "API loop lost rows"
+    )
+    assert int(np.asarray(res_a.stats.dropped_send).sum()) == 0
+    assert int(np.asarray(res_a.stats.dropped_recv).sum()) == 0
+
     out = {
         "metric": "config1_redistribute_pps",
         "value": round(vR * n_loc / per_step_p, 2),
         "unit": "particles/s",
+        # which engine the headline number measures (the planar
+        # payload-sort engine since round 3 — round-over-round dashboards
+        # should not read the 2.2x round-2->3 jump as same-engine gains)
+        "engine": "planar",
         "bit_equal_vs_oracle": True,
         "n_total": n_total,  # one-shot bit-equality check population
         "ranks": R,
@@ -173,12 +217,18 @@ def run(n_total: int = None, reps: int = 3) -> dict:
         "canonical_ms_per_step": round(per_step_p * 1e3, 3),
         "canonical_rowmajor_ms_per_step": round(per_step * 1e3, 3),
         "canonical_vranks": vR,
+        # the public GridRedistribute.redistribute() path, per call, in a
+        # pipelined steady-state loop (includes boundary fuse/unfuse and
+        # per-call dispatch; the scan number above is the engine alone)
+        "api_ms_per_step": round(api_per_step * 1e3, 3),
+        "api_pps": round(vR * n_loc / api_per_step, 2),
     }
     common.log(f"config1: {t*1e3:.1f} ms/call (incl. dispatch overhead)")
     common.log(
         f"config1: canonical exchange planar {per_step_p*1e3:.2f} vs "
         f"row-major {per_step*1e3:.2f} ms/step on-device "
-        f"({vR} vranks x {n_loc} rows, scan-differenced)"
+        f"({vR} vranks x {n_loc} rows, scan-differenced); public API "
+        f"{api_per_step*1e3:.2f} ms/call (pipelined loop)"
     )
     return out
 

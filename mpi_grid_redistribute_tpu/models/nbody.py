@@ -297,12 +297,13 @@ def make_migrate_loop(
         tiled T(8,128) layout (42.7x padding for [n, 3]). Fine at
         config-5 scales (~7.5M rows -> ~3.8 GB transient); the deposit
         path is not part of the 64M planar north-star."""
+        pos_rows = lax.bitcast_convert_type(fused[:D, :], jnp.float32)
         if vgrid is not None:
-            pv = fused[:D, :].reshape(D, V, -1).transpose(1, 2, 0)
-            valid = fused[-1, :].reshape(V, -1) > 0.5
+            pv = pos_rows.reshape(D, V, -1).transpose(1, 2, 0)
+            valid = fused[-1, :].reshape(V, -1) > 0
         else:
-            pv = fused[:D, :].T
-            valid = fused[-1, :] > 0.5
+            pv = pos_rows.T
+            valid = fused[-1, :] > 0
         return dep_fn(pv, jnp.ones(pv.shape[:-1], pv.dtype), valid)
 
     def shard_loop(pos_flat, vel_flat, alive):
@@ -311,11 +312,20 @@ def make_migrate_loop(
         # and the reshape to [D, n] splits the MAJOR axis — no row-major
         # [n, D] buffer ever exists on device (the T(8,128) input copy of
         # one is 42.7x padded: 32 GB at 64M particles, measured).
+        # The fused carry is INT32 (values bitcast): TPU float vector
+        # chains flush denormal f32 bit patterns (any bitcast int payload
+        # < 2^23 — measured on-chip, round 4), integer lanes don't; the
+        # drift below views position/velocity rows as f32 for the
+        # arithmetic only (migrate.fuse_fields).
         fused = jnp.concatenate(
             [
-                pos_flat.reshape(D, -1),
-                vel_flat.reshape(D, -1),
-                alive.astype(jnp.float32)[None, :],
+                lax.bitcast_convert_type(
+                    pos_flat.reshape(D, -1), jnp.int32
+                ),
+                lax.bitcast_convert_type(
+                    vel_flat.reshape(D, -1), jnp.int32
+                ),
+                alive.astype(jnp.int32)[None, :],
             ],
             axis=0,
         )
@@ -330,10 +340,14 @@ def make_migrate_loop(
 
         def body(carry, _):
             state = carry[0]
-            f = state.fused  # planar [K, m]
-            p = f[:D, :] + f[D : 2 * D, :] * jnp.asarray(cfg.dt, f.dtype)
+            f = state.fused  # planar int32 [K, m]
+            pf = lax.bitcast_convert_type(f[:D, :], jnp.float32)
+            vf = lax.bitcast_convert_type(f[D : 2 * D, :], jnp.float32)
+            p = pf + vf * jnp.asarray(cfg.dt, pf.dtype)
             p = binning.wrap_periodic_planar(p, cfg.domain)
-            f = jnp.concatenate([p, f[D:, :]], axis=0)
+            f = jnp.concatenate(
+                [lax.bitcast_convert_type(p, jnp.int32), f[D:, :]], axis=0
+            )
             state, stats = mig(state._replace(fused=f))
             new_carry = (state,)
             if deposit_each_step:
@@ -368,9 +382,11 @@ def make_migrate_loop(
         # planar exit: row-slices of the fused matrix, flattened
         # component-major — again no [n, D] buffer materializes
         f = state.fused
-        pos_f = f[:D, :].reshape(-1)
-        vel_f = f[D : 2 * D, :].reshape(-1)
-        alive_f = f[-1, :] > 0.5
+        pos_f = lax.bitcast_convert_type(f[:D, :], jnp.float32).reshape(-1)
+        vel_f = lax.bitcast_convert_type(
+            f[D : 2 * D, :], jnp.float32
+        ).reshape(-1)
+        alive_f = f[-1, :] > 0
         if dep_fn is None:
             return pos_f, vel_f, alive_f, stats
         rho = carry[1] if deposit_each_step else _deposit(state.fused)

@@ -214,6 +214,61 @@ def compact_received(
     return _finish_compact(values, order, jnp.sum(recv_counts), out_capacity)
 
 
+def planar_compact_with_self(
+    pool: jax.Array,
+    recv_counts: jax.Array,
+    me,
+    self_mask: jax.Array,
+    local: jax.Array,
+    out_capacity: int,
+):
+    """Planar twin of :func:`compact_with_self`: ``[K, R*C]`` receive pool +
+    ``[K, n]`` locally-retained columns -> ``[K, out_capacity]`` in exact MPI
+    Alltoallv receive order (source-major, stable within source, self rows
+    spliced at source position ``me`` — keys from :func:`pool_source_keys`,
+    the single definition both layouts share).
+
+    The reorder is a PAYLOAD-CARRYING sort: the K payload rows ride
+    ``lax.sort`` as extra operands so the sort network itself moves the
+    bytes. A key-sort + per-column gather pays ~24 ns per gathered output
+    column (measured: 126.7 ms of a 148.3 ms step at 4.2M rows —
+    scripts/microbench_planar_canonical.py); the payload sort does the same
+    reorder in ~43 ms. Sorts are cheap on TPU, per-element placement is
+    not. Invalid columns fold into the key as sentinel R (they sort last
+    and are zero-masked, so their internal order is irrelevant); iota keeps
+    the permutation unique, hence deterministic without ``is_stable``.
+
+    Returns ``(out [K, out_capacity], new_count, dropped)`` — columns
+    beyond ``new_count`` are zero.
+    """
+    R = recv_counts.shape[0]
+    C = pool.shape[1] // R
+    invalid, source_key = pool_source_keys(recv_counts, self_mask, me, C)
+    source_key = jnp.where(invalid, R, source_key)
+    values = jnp.concatenate([pool, local], axis=1)  # [K, R*C + n]
+    m = values.shape[1]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    operands = (source_key, iota) + tuple(
+        values[k] for k in range(values.shape[0])
+    )
+    sorted_ops = jax.lax.sort(operands, num_keys=2, is_stable=False)
+    payload = jnp.stack(sorted_ops[2:], axis=0)
+    if payload.shape[1] < out_capacity:
+        # pool smaller than the output: zero-pad (the tail is beyond
+        # new_count <= m, so the mask below keeps it zero)
+        payload = jnp.pad(
+            payload, ((0, 0), (0, out_capacity - payload.shape[1]))
+        )
+    else:
+        payload = payload[:, :out_capacity]
+    new_full = jnp.sum(recv_counts) + jnp.sum(self_mask.astype(jnp.int32))
+    dropped = jnp.maximum(new_full - out_capacity, 0)
+    new_count = jnp.minimum(new_full, out_capacity)
+    col_valid = jnp.arange(out_capacity, dtype=jnp.int32) < new_count
+    out = jnp.where(col_valid[None, :], payload, 0)
+    return out, new_count.astype(jnp.int32), dropped.astype(jnp.int32)
+
+
 def pack_cols(fused, order, bounds, send_counts, n_dest: int,
                capacity: int):
     """Gather the first ``send_counts[d]`` sorted columns of each
@@ -231,7 +286,13 @@ def pack_cols(fused, order, bounds, send_counts, n_dest: int,
     slot_valid = flat_c < send_counts[flat_d]
     src = jnp.minimum(bounds[flat_d] + flat_c, n - 1)
     gather_idx = order[src]  # [n_dest*C] unique over valid slots
+    # dtype-generic zero fill: the planar canonical engines transport the
+    # fused matrix BITCAST TO INT32 through this gather — TPU float vector
+    # copies flush denormal f32 bit patterns to zero (measured: bitcast
+    # int32 ids < 2^23 corrupted through this exact gather+mask at
+    # ~3k rows/shard; the same hazard ops/pallas_overlay.py biases
+    # around), while integer lanes have no FTZ semantics.
     send = jnp.where(
-        slot_valid[None, :], jnp.take(fused, gather_idx, axis=1), 0.0
+        slot_valid[None, :], jnp.take(fused, gather_idx, axis=1), 0
     )
     return send, gather_idx

@@ -110,9 +110,9 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
     # reassemble 32-bit words from the exact-integer half-planes
     hi = acc[0:k, :].astype(jnp.int32)
     lo = acc[k : 2 * k, :].astype(jnp.int32)
-    words = jax.lax.bitcast_convert_type(
-        (hi << 16) | lo, jnp.float32
-    )
+    words = (hi << 16) | lo
+    if in_ref.dtype != jnp.int32:
+        words = jax.lax.bitcast_convert_type(words, in_ref.dtype)
     hit = acc[2 * k : 2 * k + 1, :] > 0.5  # ones-row matmul = hit count
     out_ref[:] = jnp.where(hit, words[0 : in_ref.shape[0], :], in_ref[:])
 
@@ -153,10 +153,12 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
                            rmax=RMAX):
     """Drop-in for ``flat.at[:, targets].set(cols, mode='drop')``.
 
-    ``flat`` f32 ``[K, m]``; ``targets`` int32 ``[P]`` unique among
-    in-range entries (>= m drops); ``cols`` f32 ``[K, P]``. Falls back to
-    the XLA scatter when the kernel contract doesn't hold (see module
-    docstring).
+    ``flat`` f32 or int32 ``[K, m]`` (int32 is the migrate engines' round-4
+    bit-pattern-safe transport; the kernel's half-plane encoding is
+    dtype-agnostic — only the final reassembly bitcast differs);
+    ``targets`` int32 ``[P]`` unique among in-range entries (>= m drops);
+    ``cols`` ``[K, P]`` matching ``flat``. Falls back to the XLA scatter
+    when the kernel contract doesn't hold (see module docstring).
     """
     k, m = flat.shape
     p = targets.shape[0]
@@ -164,7 +166,8 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
         m % w
         or m >= (1 << 30)  # target encoding bound (never denormal/NaN)
         or 2 * k + 2 > ROWS
-        or flat.dtype != jnp.float32
+        or flat.dtype not in (jnp.float32, jnp.int32)
+        or cols.dtype != flat.dtype
     ):
         return flat.at[:, targets].set(cols, mode="drop")
     sentinel = jnp.int32(m)

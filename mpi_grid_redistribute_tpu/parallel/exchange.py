@@ -216,7 +216,14 @@ def vrank_redistribute_planar_fn(
     ``count_out[v]`` are zero padding. Bitcast non-float32 fields on the
     way in/out (:func:`..migrate.fuse_fields` semantics, minus the alive
     row — validity here is the count prefix, as everywhere on the
-    canonical path).
+    canonical path). ``fused`` may be float32 or int32; either way the
+    TRANSPORT (pack gather, wire, compaction sort) runs on an int32
+    bitcast view — TPU float vector copies flush denormal f32 bit
+    patterns to zero (any bitcast int < 2^23; measured through the pack
+    gather at ~3k rows/shard — the hazard ops/pallas_overlay.py biases
+    around), while integer lanes have no FTZ semantics, so every 32-bit
+    pattern (denormals, NaN payloads, -0.0) survives bit-exactly by
+    construction. Output dtype matches the input.
     """
     V = grid.nranks
     C = capacity
@@ -229,13 +236,26 @@ def vrank_redistribute_planar_fn(
                 f"components first, then 32-bit fields), got "
                 f"{fused.shape}"
             )
+        if fused.dtype not in (jnp.float32, jnp.int32):
+            raise TypeError(
+                f"fused must be float32 or int32, got {fused.dtype}"
+            )
+        as_f32 = fused.dtype == jnp.float32
+        fi = (
+            lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+        )
+        pos_f = (
+            fused[:, :D, :]
+            if as_f32
+            else lax.bitcast_convert_type(fi[:, :D, :], jnp.float32)
+        )
         n = fused.shape[2]
         me_ids = jnp.arange(V, dtype=jnp.int32)
 
-        def pack_one(f_v, count_v, me):
+        def pack_one(fi_v, pos_v, count_v, me):
             iota = jnp.arange(n, dtype=jnp.int32)
             valid = iota < count_v
-            dest = binning.rank_of_position_planar(f_v[:D], domain, grid)
+            dest = binning.rank_of_position_planar(pos_v, domain, grid)
             dest = jnp.where(valid, dest, V).astype(jnp.int32)
             is_self = valid & (dest == me)
             dest_remote = jnp.where(is_self, V, dest)
@@ -245,14 +265,14 @@ def vrank_redistribute_planar_fn(
             dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
             send_counts = jnp.minimum(remote_counts, C)
             packed, _ = pack.pack_cols(
-                f_v, order, bounds[:V], send_counts, V, C
-            )  # [K, V*C]
+                fi_v, order, bounds[:V], send_counts, V, C
+            )  # [K, V*C] int32
             needed = jnp.max(remote_counts).astype(jnp.int32)
             return packed, send_counts, is_self, dropped_send, needed
 
         packed, send_counts, is_self, dropped_send, needed = jax.vmap(
             pack_one
-        )(fused, count, me_ids)
+        )(fi, pos_f, count, me_ids)
         K = fused.shape[1]
         # the wire, as a transpose: [V_src, K, V_dst, C] -> dst-major pools
         recv = (
@@ -262,53 +282,20 @@ def vrank_redistribute_planar_fn(
         )
         recv_counts = send_counts.T  # [V_dst, V_src]
 
-        def compact_one(pool_v, rcnt_v, me, self_mask_v, f_v):
-            # Alltoallv-order compaction via a PAYLOAD-CARRYING sort: the
-            # K payload rows ride the lax.sort as extra operands, so the
-            # sort network itself moves the bytes. A key-sort + per-column
-            # gather was measured at ~24 ns per gathered column (126 ms of
-            # a 148 ms step at 4.2M rows — scripts/
-            # microbench_planar_canonical.py); the payload sort does the
-            # same reorder in ~43 ms: sorts are cheap on TPU, per-element
-            # placement is not. Invalid columns fold into the key as
-            # sentinel V (they sort last and are zero-masked, so their
-            # internal order is irrelevant); iota keeps the permutation
-            # unique, hence deterministic without is_stable.
-            invalid, source_key = pack.pool_source_keys(
-                rcnt_v, self_mask_v, me, C
+        def compact_one(pool_v, rcnt_v, me, self_mask_v, fi_v):
+            # Alltoallv-order compaction via a payload-carrying sort —
+            # shared with the shard_map planar twin so the two engines
+            # cannot drift (see pack.planar_compact_with_self for the
+            # measured rationale). int32 operands throughout.
+            return pack.planar_compact_with_self(
+                pool_v, rcnt_v, me, self_mask_v, fi_v, out_capacity
             )
-            source_key = jnp.where(invalid, V, source_key)
-            values = jnp.concatenate([pool_v, f_v], axis=1)  # [K, V*C+n]
-            m = values.shape[1]
-            iota = jnp.arange(m, dtype=jnp.int32)
-            operands = (source_key, iota) + tuple(
-                values[k] for k in range(values.shape[0])
-            )
-            sorted_ops = jax.lax.sort(operands, num_keys=2, is_stable=False)
-            payload = jnp.stack(sorted_ops[2:], axis=0)
-            if payload.shape[1] < out_capacity:
-                # pool smaller than the output: zero-pad (the tail is
-                # beyond new_count <= m, so the mask below keeps it zero)
-                payload = jnp.pad(
-                    payload,
-                    ((0, 0), (0, out_capacity - payload.shape[1])),
-                )
-            else:
-                payload = payload[:, :out_capacity]
-            new_full = jnp.sum(rcnt_v) + jnp.sum(
-                self_mask_v.astype(jnp.int32)
-            )
-            dropped = jnp.maximum(new_full - out_capacity, 0)
-            new_count = jnp.minimum(new_full, out_capacity)
-            col_valid = (
-                jnp.arange(out_capacity, dtype=jnp.int32) < new_count
-            )
-            out = jnp.where(col_valid[None, :], payload, 0)
-            return out, new_count.astype(jnp.int32), dropped.astype(jnp.int32)
 
         out, new_count, dropped_recv = jax.vmap(compact_one)(
-            recv, recv_counts, me_ids, is_self, fused
+            recv, recv_counts, me_ids, is_self, fi
         )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
         self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
         self_diag = jnp.diag(self_count)
         stats = RedistributeStats(
@@ -321,6 +308,160 @@ def vrank_redistribute_planar_fn(
         return out, new_count, stats
 
     return fn
+
+
+def shard_redistribute_planar_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    ndim: int = None,
+):
+    """PLANAR multi-device canonical exchange (runs under ``shard_map``).
+
+    The shard_map twin of :func:`vrank_redistribute_planar_fn`: same
+    routing (``binning.rank_of_position_planar``), same ``pack_cols`` pack,
+    same payload-carrying-sort compaction
+    (``pack.planar_compact_with_self``), same capacity/overflow accounting
+    — but the V-way transpose is a real ``lax.all_to_all`` over the mesh
+    axes, riding ICI. The per-shard state is ``[K, n]`` component-major
+    throughout: no narrow-minor ``[n, 3]`` buffer exists on either side of
+    the wire (the row-major :func:`shard_redistribute_fn` gathers and
+    exchanges ``[R, C, 3]`` buffers, every one stored in TPU's tiled
+    T(8,128) layout at 42.7x the logical bytes — the measured 7x per-row
+    deficit the planar engines remove, BENCH_CONFIGS.md config 1).
+
+    Signature of the returned fn: ``(fused[K, n], count[1] int32) ->
+    (fused_out[K, out_capacity], count_out[1], stats)``; columns beyond
+    ``count_out`` are zero. 32-bit fields ride bitcast
+    (:func:`..migrate.fuse_fields` semantics, minus the alive row).
+    ``fused`` may be float32 or int32; the transport runs on an int32
+    bitcast view either way (TPU denormal-flush hazard — see
+    :func:`vrank_redistribute_planar_fn`); output dtype matches input.
+    """
+    R = grid.nranks
+    C = capacity
+    D = domain.ndim if ndim is None else ndim
+    axes = grid.axis_names
+
+    def fn(fused, count):
+        if fused.ndim != 2 or fused.shape[0] < D:
+            raise ValueError(
+                f"fused must be [K>={D}, n] per shard (K rows: {D} "
+                f"position components first, then 32-bit fields), got "
+                f"{fused.shape}"
+            )
+        if fused.dtype not in (jnp.float32, jnp.int32):
+            raise TypeError(
+                f"fused must be float32 or int32, got {fused.dtype}"
+            )
+        as_f32 = fused.dtype == jnp.float32
+        fi = (
+            lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+        )
+        pos_f = (
+            fused[:D]
+            if as_f32
+            else lax.bitcast_convert_type(fi[:D], jnp.float32)
+        )
+        n = fused.shape[1]
+        me = lax.axis_index(axes).astype(jnp.int32)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        valid = iota < count[0]
+        dest = binning.rank_of_position_planar(pos_f, domain, grid)
+        dest = jnp.where(valid, dest, R).astype(jnp.int32)
+        # Self-owned columns stay local (never hit the wire); sentinel R
+        # routes both invalid and self columns out of the remote pack.
+        is_self = valid & (dest == me)
+        dest_remote = jnp.where(is_self, R, dest)
+        order, remote_counts, bounds = binning.sorted_dest_counts(
+            dest_remote, R
+        )
+        dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
+        send_counts = jnp.minimum(remote_counts, C)
+        packed, _ = pack.pack_cols(
+            fi, order, bounds[:R], send_counts, R, C
+        )  # [K, R*C] int32, dest-major slots
+        recv_counts = lax.all_to_all(
+            send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+        )
+        # The wire: tiled all_to_all splits the lane axis into R chunks of
+        # C columns (chunk d -> rank d) and concatenates receives
+        # source-major — exactly the [K, R*C] dst-major pool the vrank
+        # twin builds with its transpose.
+        pool = lax.all_to_all(
+            packed, axes, split_axis=1, concat_axis=1, tiled=True
+        )
+        out, new_count, dropped_recv = pack.planar_compact_with_self(
+            pool, recv_counts, me, is_self, fi, out_capacity
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32))
+        self_onehot = (jnp.arange(R, dtype=jnp.int32) == me) * self_count
+        stats = RedistributeStats(
+            send_counts=(send_counts + self_onehot)[None, :],
+            recv_counts=(recv_counts + self_onehot)[None, :],
+            dropped_send=dropped_send[None].astype(jnp.int32),
+            dropped_recv=dropped_recv[None],
+            needed_capacity=jnp.max(remote_counts)[None].astype(jnp.int32),
+        )
+        return out, new_count[None], stats
+
+    return fn
+
+
+def shard_redistribute_planar_sharded(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    ndim: int = None,
+):
+    """``shard_map``-wrapped (unjitted) planar exchange — composable under
+    an outer jit (the public API fuses its field-bitcast boundary into the
+    same program; see :mod:`..api`).
+
+    Global layout: ``fused`` is ``[K, R * n_local]`` component-major,
+    sharded on the LANE axis over all mesh axes (x-major, matching rank
+    order — shard r owns columns ``[r * n_local, (r + 1) * n_local)``);
+    ``count`` is ``[R]`` int32 with one entry per shard. Returns
+    ``(fused_out [K, R * out_capacity], count_out [R], stats)``.
+    """
+    axes = grid.axis_names
+    spec_f = P(None, axes)
+    spec_c = P(axes)
+    fn = shard_redistribute_planar_fn(
+        domain, grid, capacity, out_capacity, ndim
+    )
+    out_specs = (
+        spec_f,
+        spec_c,
+        RedistributeStats(
+            *([spec_c] * len(RedistributeStats._fields))
+        ),
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec_f, spec_c), out_specs=out_specs
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute_planar(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    ndim: int = None,
+):
+    """jit of :func:`shard_redistribute_planar_sharded` (global planar)."""
+    return jax.jit(
+        shard_redistribute_planar_sharded(
+            mesh, domain, grid, capacity, out_capacity, ndim
+        )
+    )
 
 
 @functools.lru_cache(maxsize=64)

@@ -12,7 +12,7 @@ stable sort. Profiling on the real chip shows the true TPU cost model:
   * a full stable sort of 4M int32 keys is ~6 ms; elementwise binning ~3 ms.
 
 **Planar layout** (round 3): the fused state is carried TRANSPOSED —
-``[K, n]`` float32, components on the sublane axis, particles on the lane
+``[K, n]``, components on the sublane axis, particles on the lane
 axis — because TPU stores any narrow-minor ``[n, K]`` buffer that
 materializes at a program boundary or scan carry in the tiled ``T(8,128)``
 layout: ``[n, 7]`` pads 128/7 = 18x (32 GB at 64M rows — the round-2 cap
@@ -38,7 +38,10 @@ Design (one compiled step, all static shapes):
      granted rows are packed — arrivals are structurally bounded by what
      can land;
   5. one fused ``[R, K, C]`` ``lax.all_to_all`` moves position + payload +
-     alive row as a single float32 matrix (32-bit fields bitcast);
+     alive row as a single INT32 matrix (everything bitcast — round 4:
+     integer transport is what keeps bit patterns exact on TPU vector
+     units, whose float chains flush denormal patterns; see
+     :func:`fuse_fields`);
   6. arrivals land exactly in the slots vacated by departures, then in slots
      popped from a carried free-slot *stack* (contiguous dynamic-slice
      push/pop — never a scatter); one single scatter per step writes
@@ -137,10 +140,36 @@ def _land_scatter(flat, targets, cols, impl: str = "xla"):
 
         return pallas_overlay.overlay_scatter_planar(flat, targets, cols)
     if impl == "rows":
+        if flat.dtype != jnp.float32:
+            # The row-store kernel is float32-only, and its per-row VMEM
+            # stores are exactly the float copy chains that flush denormal
+            # bit patterns — running it on a bitcast view of the int32
+            # transport would reintroduce the round-4 corruption. Fail
+            # loudly rather than silently measuring the XLA scatter under
+            # the "rows" label.
+            raise TypeError(
+                "scatter_impl='rows' (MPI_GRID_LAND_SCATTER=rows) is "
+                "float32-only and incompatible with the int32 bit-exact "
+                "transport the migrate engines now carry; use 'overlay' "
+                "or 'xla'"
+            )
         from mpi_grid_redistribute_tpu.ops import pallas_scatter
 
         return pallas_scatter.scatter_rows(flat.T, targets, cols.T).T
     return flat.at[:, targets].set(cols, mode="drop")
+
+
+def _pos_row(flat: jax.Array, d: int) -> jax.Array:
+    """float32 VIEW of position row ``d`` of the fused state.
+
+    The fused transport matrix is int32 (bit-pattern-safe on TPU vector
+    units — see :func:`fuse_fields`); binning arithmetic needs the float
+    values, so position rows are bitcast back here. Legacy float32 state
+    passes through untouched."""
+    row = flat[d, :]
+    if row.dtype == jnp.int32:
+        return lax.bitcast_convert_type(row, jnp.float32)
+    return row
 
 
 class MigrateStats(NamedTuple):
@@ -162,9 +191,13 @@ class MigrateStats(NamedTuple):
 class MigrateState(NamedTuple):
     """Scan-carry state for the fused migration loop.
 
-    ``fused`` is PLANAR ``[K, n]`` float32 (``[K, V * n]`` with V vranks —
+    ``fused`` is PLANAR ``[K, n]`` int32 (``[K, V * n]`` with V vranks —
     vrank ``v`` owns lane columns ``[v * n, (v + 1) * n)``): position
-    component rows first, payload rows, and the alive row last.
+    component rows first (float32 values bitcast; view via
+    :func:`_pos_row`), payload rows, and the alive row last (1/0).
+    Legacy float32 state is still accepted by the engines, but only the
+    int32 transport is bit-exact for arbitrary payload patterns on TPU
+    (see :func:`fuse_fields`).
     ``free_stack`` / ``n_free`` are the hole-slot stack (indices of dead
     columns; only the first ``n_free`` entries are live), per vrank
     (``[V, n]`` / ``[V]``) on the vrank path."""
@@ -175,12 +208,18 @@ class MigrateState(NamedTuple):
 
 
 def fuse_fields(arrays: Sequence[jax.Array], alive: jax.Array):
-    """Pack [n, ...] arrays + alive mask into one PLANAR [K, n] float32
+    """Pack [n, ...] arrays + alive mask into one PLANAR [K, n] INT32
     matrix (components on the sublane axis — see module docstring).
 
-    32-bit dtypes are bitcast; the fused matrix only ever moves bytes
-    (gather/scatter/all_to_all), so bit patterns survive exactly. The alive
-    mask becomes the last row (1.0/0.0).
+    32-bit dtypes are bitcast to int32 — the INTEGER transport is what
+    makes "bit patterns survive exactly" TRUE ON HARDWARE: TPU float
+    vector chains (fused gather/select/concat passes over f32 state)
+    flush denormal f32 bit patterns — any bitcast int below 2^23 — to
+    zero (measured on-chip in round 4: a bitcast-int32 id row came back
+    all zeros through the f32 drift loop), while integer lanes have no
+    FTZ semantics. The engines bitcast position rows back to float32
+    views only where binning arithmetic needs values. The alive mask
+    becomes the last row (1/0).
 
     Returns ``(fused, specs)``; ``specs`` drives :func:`unfuse_fields`.
     """
@@ -193,16 +232,17 @@ def fuse_fields(arrays: Sequence[jax.Array], alive: jax.Array):
                 f"{a.dtype}; cast or split the field"
             )
         flat = a.reshape(n, -1)
-        if flat.dtype != jnp.float32:
-            flat = lax.bitcast_convert_type(flat, jnp.float32)
+        if flat.dtype != jnp.int32:
+            flat = lax.bitcast_convert_type(flat, jnp.int32)
         parts.append(flat.T)
         specs.append((a.shape[1:], a.dtype))
-    parts.append(alive.astype(jnp.float32)[None, :])
+    parts.append(alive.astype(jnp.int32)[None, :])
     return jnp.concatenate(parts, axis=0), tuple(specs)
 
 
 def unfuse_fields(fused: jax.Array, specs):
-    """Inverse of :func:`fuse_fields`: ``(arrays..., alive)``."""
+    """Inverse of :func:`fuse_fields`: ``(arrays..., alive)``. Accepts the
+    int32 transport layout (canonical) or the legacy float32 layout."""
     out = []
     row = 0
     n = fused.shape[1]
@@ -211,11 +251,11 @@ def unfuse_fields(fused: jax.Array, specs):
         for s in shape:
             k *= s
         flat = fused[row : row + k, :].T
-        if dtype != jnp.float32:
+        if dtype != flat.dtype:
             flat = lax.bitcast_convert_type(flat, dtype)
         out.append(flat.reshape((n,) + tuple(shape)))
         row += k
-    alive = fused[-1, :] > 0.5
+    alive = fused[-1, :] > 0
     return tuple(out), alive
 
 
@@ -234,7 +274,7 @@ def init_state(
     """
     if batched is None:
         batched = vranks > 1
-    alive = fused[-1, :] > 0.5
+    alive = fused[-1, :] > 0  # alive row is exactly 0/1 in either dtype
     if batched:
         alive = alive.reshape(vranks, -1)
 
@@ -423,7 +463,7 @@ def _land_arrivals(
             jnp.where((k_idx >= n_in) & (k_idx < n_sent), vacated, n),
         ),
     )
-    cols = jnp.where((k_idx < n_in)[None, :], arrivals, 0.0)
+    cols = jnp.where((k_idx < n_in)[None, :], arrivals, 0)
     # THE scatter: payload + alive flag + hole markers in one pass.
     fused = _land_scatter(fused, target, cols, scatter_impl)
 
@@ -464,12 +504,12 @@ def shard_migrate_fused_fn(
         fused, free_stack, n_free = state
         K = fused.shape[0]
         me = lax.axis_index(axes).astype(jnp.int32)
-        alive = fused[-1, :] > 0.5
+        alive = fused[-1, :] > 0
         # per-axis fused elementwise binning (no stacked [D, n]
         # intermediates; see the vranks path for the measurement)
         dest = jnp.zeros(fused.shape[1:], jnp.int32)
         for d in range(D):
-            p = fused[d, :]
+            p = _pos_row(fused, d)
             lo = jnp.asarray(domain.lo[d], p.dtype)
             ext = jnp.asarray(domain.extent[d], p.dtype)
             if domain.periodic[d]:
@@ -548,7 +588,7 @@ def shard_migrate_fused_fn(
             fused, free_stack, n_free, recv, recv_counts, send_counts,
             gather_idx, C, impl,
         )
-        population = jnp.sum((fused[-1, :] > 0.5).astype(jnp.int32))
+        population = jnp.sum((fused[-1, :] > 0).astype(jnp.int32))
         stats = MigrateStats(
             sent=jnp.sum(send_counts).astype(jnp.int32)[None],
             received=n_in[None],
@@ -742,11 +782,11 @@ def shard_migrate_vranks_fn(
         # [D, m] intermediates — each axis's wrap+floor+clip+accumulate
         # fuses into one pass over [V*n]; the stacked helper variant
         # measured 22x its bandwidth roofline in the knockout profile)
-        alive = flat[-1, :].reshape(V, n) > 0.5
+        alive = flat[-1, :].reshape(V, n) > 0
         dest_dev = jnp.zeros((V * n,), jnp.int32)
         dest_v = jnp.zeros((V * n,), jnp.int32)
         for d in range(D):
-            p = flat[d, :]
+            p = _pos_row(flat, d)
             lo = jnp.asarray(domain.lo[d], p.dtype)
             ext = jnp.asarray(domain.extent[d], p.dtype)
             if domain.periodic[d]:
@@ -918,7 +958,7 @@ def shard_migrate_vranks_fn(
                 K, V, Dev, V, C
             )
             send = jnp.where(
-                valid.reshape(V, Dev, V, C)[None], vals, 0.0
+                valid.reshape(V, Dev, V, C)[None], vals, 0
             )
             # [K, V_src, Dev, V_dst, C] -> [Dev, V_src, V_dst, K, C]
             send = send.transpose(2, 1, 3, 0, 4)
@@ -1005,7 +1045,7 @@ def shard_migrate_vranks_fn(
             arr_cols
         )
         cols_w = jnp.where(
-            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0.0
+            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0
         )
         flat = _land_scatter(
             flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
@@ -1044,7 +1084,7 @@ def shard_migrate_vranks_fn(
                 pop_i = jnp.clip(nf - 1 - kr, 0, n - 1)
                 tgt = jnp.where(kr < npop, fs[pop_i], n)
                 f = f.at[:, tgt].set(
-                    jnp.where((kr < nin)[None, :], arrivals, 0.0),
+                    jnp.where((kr < nin)[None, :], arrivals, 0),
                     mode="drop",
                 )
                 return f, nf - npop, nin, dropped
@@ -1063,7 +1103,7 @@ def shard_migrate_vranks_fn(
 
         backlog = (leavers - n_sent).astype(jnp.int32)
         population = jnp.sum(
-            (flat[-1, :].reshape(V, n) > 0.5).astype(jnp.int32), axis=1
+            (flat[-1, :].reshape(V, n) > 0).astype(jnp.int32), axis=1
         )
         stats = MigrateStats(
             sent=n_sent,
