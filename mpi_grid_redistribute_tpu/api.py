@@ -49,7 +49,7 @@ def _next_pow2(n: int) -> int:
 def _planar_specs(positions, fields):
     """Per-array (trailing_shape, dtype, n_rows) specs for the planar
     engines, or ``None`` when any array is not 32-bit (the planar fused
-    state bitcasts everything to float32 rows — ``migrate.fuse_fields``
+    state bitcasts everything to int32 rows — ``migrate.fuse_fields``
     semantics; 8/16/64-bit fields fall back to the row-major engine)."""
     specs = []
     for a in (positions,) + tuple(fields):

@@ -13,11 +13,22 @@ code* — semantic drift between backend and oracle is structurally impossible.
 
 from __future__ import annotations
 
+import contextlib
 import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _np_quiet(xp):
+    """Silence NumPy overflow/invalid warnings on the oracle twin (the
+    JAX path never warns); a no-op for jnp. ONE context guards the ONE
+    copy of each bit-sensitive expression — duplicating the expression
+    per backend would let the twins drift."""
+    if xp is np:
+        return np.errstate(over="ignore", invalid="ignore")
+    return contextlib.nullcontext()
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 
@@ -37,10 +48,16 @@ def remainder_fast(q, ext: float, xp=jnp):
     chain measured 6.9 ms with ``jnp.remainder`` vs 1.75 ms with
     ``q - floor(q * (1/ext)) * ext`` at 8.4M rows
     (scripts/microbench_leaver_compact.py). For power-of-two extents the
-    two are BIT-EQUAL (1/ext, the scale and the final subtraction are all
-    exact — IEEE remainder by an exact-reciprocal divisor), so the fast
-    path preserves the engines' bit-compatibility with the NumPy oracle,
-    which is why it only engages when exactness is guaranteed.
+    two are BIT-EQUAL on non-overflowing inputs (``|q| < f32max * ext``:
+    1/ext, the scale and the final subtraction are all exact — IEEE
+    remainder by an exact-reciprocal divisor), so the fast path preserves
+    the engines' bit-compatibility with the NumPy oracle, which is why it
+    only engages when exactness is guaranteed. Beyond that bound (ext < 1
+    with |q| near f32max) the product overflows to inf and the fold below
+    TOTALIZES the result to 0 — identically on every backend (both twins
+    share this function), but differing from ``jnp.remainder``'s value
+    there; the claim is engine/oracle compatibility, not equality with
+    ``remainder`` on absurd inputs.
 
     One non-exact corner is handled explicitly: when ``|q|`` is tiny
     enough that ``q * (1/ext)`` is denormal, a flush-to-zero backend (TPU
@@ -54,8 +71,9 @@ def remainder_fast(q, ext: float, xp=jnp):
     """
     if _is_pow2(float(ext)):
         dt = q.dtype.type
-        r = q - xp.floor(q * dt(1.0 / ext)) * dt(ext)
-        return xp.where((r < dt(0)) | (r >= dt(ext)), dt(0), r)
+        with _np_quiet(xp):
+            r = q - xp.floor(q * dt(1.0 / ext)) * dt(ext)
+            return xp.where((r < dt(0)) | (r >= dt(ext)), dt(0), r)
     return xp.remainder(q, xp.asarray(ext, dtype=q.dtype))
 
 
@@ -80,7 +98,8 @@ def wrap_periodic(pos, domain: Domain, xp=jnp):
             [1.0 / e if _is_pow2(float(e)) else 0.0 for e in domain.extent],
             dtype=pos.dtype,
         )
-        r = q - xp.floor(q * inv) * extent
+        with _np_quiet(xp):
+            r = q - xp.floor(q * inv) * extent
         # denormal-product FTZ fold: see remainder_fast
         wrapped = lo + xp.where(r < 0, xp.zeros_like(r), r)
     else:

@@ -241,8 +241,14 @@ def _sorted_per_segment(
     n = key.shape[0]
     ndim = rel.shape[1]
     iota = jnp.arange(n, dtype=jnp.int32)
+    # num_keys=2 makes the within-segment order STABLE (iota ascending),
+    # which pins the prefix-sum rounding order — the planar core uses the
+    # same (key, iota) order, so the two engines' per-cell sums are
+    # bit-identical (tested). With num_keys=1 the within-key order was
+    # sort-network-defined: deterministic per compile, but not a shared
+    # contract.
     keys_sorted, order = jax.lax.sort(
-        (key, iota), num_keys=1, is_stable=False
+        (key, iota), num_keys=2, is_stable=False
     )
     # ONE wide row gather: narrow [N]-gathers cost more than a single
     # [N, 4] one on TPU (measured 60 ms for a lone [4M] bool gather).
@@ -311,6 +317,153 @@ def _sorted_per_segment(
     # shared prefix exactly to ulp(difference); the lo difference restores
     # what the hi words rounded away.
     return (g_hi[1:] - g_hi[:-1]) + (g_lo[1:] - g_lo[:-1])
+
+
+def _sorted_per_segment_planar(
+    key, rel_rows, mass, n_segments: int, local_shape, tile: int
+):
+    """PLANAR twin of :func:`_sorted_per_segment`: payload-carrying sort,
+    channel rows on sublanes, column gathers at boundaries.
+
+    ``key`` [N] int32 (sentinel ``n_segments`` for invalid rows);
+    ``rel_rows`` [D, N] planar block-local coordinates; ``mass`` [N]
+    (already zeroed on invalid rows). Returns ``per_cell
+    [2^D, n_segments]`` PLANAR.
+
+    Differences from the row-major core, all layout: the ``[N, D+1]``
+    payload gather becomes extra ``lax.sort`` operands (the sort network
+    moves the bytes — the canonical-engine trick); the ``[N, 8]`` weight
+    channels become ``[8, N]`` rows (T(8,128) pads ``[N, 8]`` 16x, rows
+    pad 1x); the boundary prefix tables gather COLUMNS of a
+    ``[16, n_pad]`` pack. Both cores sort by (key, iota) with 2 compare
+    keys, pinning the within-segment summation order, so per-cell sums
+    are bit-identical between the planar and row-major engines (tested).
+    """
+    n = key.shape[0]
+    D = rel_rows.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    operands = (key, iota) + tuple(rel_rows[d] for d in range(D)) + (mass,)
+    s = jax.lax.sort(operands, num_keys=2, is_stable=False)
+    keys_sorted = s[0]
+    rel_s = jnp.stack(s[2 : 2 + D], axis=0)  # [D, N] sorted
+    mass_s = s[2 + D]
+    i0_s = jnp.clip(
+        jnp.floor(rel_s).astype(jnp.int32),
+        0,
+        jnp.asarray(local_shape, jnp.int32)[:, None] - 1,
+    )
+    frac = jnp.clip(rel_s - i0_s.astype(rel_s.dtype), 0.0, 1.0)  # [D, N]
+
+    # corner-weight channel rows [2^D, N], sorted order. The product
+    # association matches the row-major core exactly —
+    # mass * ((f0 * f1) * f2), i.e. jnp.prod's reduction order then the
+    # mass multiply — so the channel values are bit-identical (a
+    # different association rounds 1-2 ulp differently).
+    rows = []
+    for corner in itertools.product((0, 1), repeat=D):
+        w = None
+        for d in range(D):
+            t = frac[d] if corner[d] == 1 else 1.0 - frac[d]
+            w = t if w is None else w * t
+        rows.append(mass_s * w)
+    w8 = jnp.stack(rows, axis=0)  # [nch, N]
+    nch = w8.shape[0]
+
+    K = max(1, min(tile, n))
+    n_pad = -(-n // K) * K
+    wt = jnp.pad(w8, ((0, 0), (0, n_pad - n))).reshape(
+        nch, n_pad // K, K
+    )
+    lhi, llo = _df_cumsum(wt, axis=2)  # within-tile inclusive prefixes
+    thi, tlo = _df_cumsum(lhi[:, :, -1], axis=1, x_lo=llo[:, :, -1])
+    z8 = jnp.zeros((nch, 1), w8.dtype)
+    s_hi = jnp.concatenate([z8, thi], axis=1)  # [nch, T + 1]
+    s_lo = jnp.concatenate([z8, tlo], axis=1)
+
+    bounds = jnp.searchsorted(
+        keys_sorted,
+        jnp.arange(n_segments + 1, dtype=jnp.int32),
+        side="left",
+        method="sort",
+    ).astype(jnp.int32)
+    t_idx = bounds // K
+    has_local = (bounds % K > 0)[None, :]
+    l_pack = jnp.concatenate(
+        [lhi.reshape(nch, n_pad), llo.reshape(nch, n_pad)], axis=0
+    )  # [2 nch, n_pad]
+    s_pack = jnp.concatenate([s_hi, s_lo], axis=0)  # [2 nch, T + 1]
+    lb = jnp.clip(bounds - 1, 0, n_pad - 1)
+    l_at = jnp.where(has_local, jnp.take(l_pack, lb, axis=1), 0.0)
+    s_at = jnp.take(s_pack, t_idx, axis=1)
+    g_hi, g_lo = _df_add(
+        s_at[:nch], s_at[nch:], l_at[:nch], l_at[nch:]
+    )  # [nch, B]
+    return (g_hi[:, 1:] - g_hi[:, :-1]) + (g_lo[:, 1:] - g_lo[:, :-1])
+
+
+def cic_deposit_vranks_planar(
+    pos_rows: jax.Array,
+    mass: jax.Array,
+    valid: jax.Array,
+    lo_local: jax.Array,
+    inv_h: jax.Array,
+    vblock: Tuple[int, ...],
+    tile: int = 256,
+) -> jax.Array:
+    """PLANAR batched scan deposit: V slabs from component-major rows.
+
+    ``pos_rows [D, V * n]`` (vrank v owns columns ``[v*n, (v+1)*n)`` —
+    the migrate engines' fused layout, minus the bitcast), ``mass`` /
+    ``valid`` ``[V * n]``, ``lo_local [V, D]``. No row-major ``[n, D]``
+    buffer ever materializes — the in-loop transpose that kept config 5
+    off the 64M north-star (round-3 verdict item 3) is gone. Per-cell
+    sums are bit-identical to :func:`cic_deposit_vranks_sorted` (shared
+    stable order; tested). Returns per-vrank ghost blocks
+    ``[V, *(vblock + 1)]``.
+    """
+    D, m = pos_rows.shape
+    V = lo_local.shape[0]
+    n = m // V
+    n_cells = math.prod(vblock)
+    if V * n_cells > 2**27:
+        raise ValueError(
+            f"cic_deposit_vranks_planar: V * prod(vblock) = {V} * "
+            f"{n_cells} = {V * n_cells} exceeds the safe int32/memory "
+            f"bound (2**27). Use a coarser deposit grid per vrank or "
+            f"fewer vranks per device."
+        )
+    rel = []
+    cell = jnp.zeros((V, n), jnp.int32)
+    for d in range(D):
+        r = (
+            pos_rows[d].reshape(V, n) - lo_local[:, d, None]
+        ) * inv_h[d]
+        r = jnp.where(valid.reshape(V, n), r, 0.0)
+        i0_d = jnp.clip(
+            jnp.floor(r).astype(jnp.int32), 0, vblock[d] - 1
+        )
+        cell = cell + i0_d * jnp.int32(_row_major_strides(vblock)[d])
+        rel.append(r.reshape(m))
+    v_ids = jnp.arange(V, dtype=jnp.int32)[:, None]
+    key = jnp.where(
+        valid.reshape(V, n), v_ids * n_cells + cell, V * n_cells
+    ).astype(jnp.int32)
+    mass_z = jnp.where(valid, mass, 0.0)
+    per_cell = _sorted_per_segment_planar(
+        key.reshape(-1), jnp.stack(rel, axis=0), mass_z,
+        V * n_cells, vblock, tile,
+    )  # [2^D, V * n_cells]
+    nch = per_cell.shape[0]
+    per_cell = per_cell.reshape((nch, V) + vblock)
+
+    ghost = tuple(b + 1 for b in vblock)
+    total = jnp.zeros((V,) + ghost, dtype=mass.dtype)
+    for k, corner in enumerate(itertools.product((0, 1), repeat=D)):
+        pad = [(0, 0)] + [
+            (c, g - b - c) for c, g, b in zip(corner, ghost, vblock)
+        ]
+        total = total + jnp.pad(per_cell[k], pad)
+    return total
 
 
 def cic_deposit_vranks_sorted(
@@ -586,6 +739,77 @@ def shard_deposit_vranks_fn(
             )(pos, mass, valid, lo_all)  # [V, *(vblock+1)]
 
         # assemble: vrank (i,j,k)'s ghost block overlaps its +1 neighbors
+        total = jnp.zeros(
+            tuple(b + 1 for b in dev_block), dtype=rho_v.dtype
+        )
+        for v in range(V):
+            vc = vgrid.cell_of_rank(v)
+            idx = tuple(
+                slice(c * b, c * b + b + 1) for c, b in zip(vc, vblock)
+            )
+            total = total.at[idx].add(rho_v[v])
+        if all(domain.periodic):
+            return fold_ghosts(total, dev_grid)
+        return assemble_dense(total, dev_grid, domain)
+
+    return fn
+
+
+def shard_deposit_vranks_planar_fn(
+    domain: Domain,
+    dev_grid: ProcessGrid,
+    vgrid: ProcessGrid,
+    mesh_shape: Tuple[int, ...],
+):
+    """PLANAR per-device CIC deposit consuming component-major rows.
+
+    The planar twin of :func:`shard_deposit_vranks_fn` (scan method):
+    signature ``(pos_rows [D, V * n], mass [V * n], valid [V * n]) ->
+    rho_local`` — the migrate engines' fused layout feeds it directly
+    (bitcast the position rows to f32), killing the in-loop ``[n, 3]``
+    transpose that kept config 5 off the 64M north-star (round-3 verdict
+    item 3: a [64M, 3] transient is a 32 GB T(8,128) allocation).
+    Works for ``V = 1`` (the flat path) too.
+    """
+    full_shape = tuple(
+        d * v for d, v in zip(dev_grid.shape, vgrid.shape)
+    )
+    full_grid = ProcessGrid(full_shape, axis_names=dev_grid.axis_names)
+    _check_mesh_shape(domain, full_grid, mesh_shape)
+    ndim = domain.ndim
+    V = vgrid.nranks
+    dev_block = tuple(
+        m // g for m, g in zip(mesh_shape, dev_grid.shape)
+    )
+    vblock = tuple(b // v for b, v in zip(dev_block, vgrid.shape))
+    inv_h = jnp.asarray(
+        [m / e for m, e in zip(mesh_shape, domain.extent)], jnp.float32
+    )
+    vwidths = full_grid.cell_widths(domain)
+    vcells = np.asarray(
+        [vgrid.cell_of_rank(v) for v in range(V)], dtype=np.float32
+    )
+
+    def fn(pos_rows, mass, valid):
+        me_cell = [
+            lax.axis_index(name).astype(jnp.int32)
+            for name in dev_grid.axis_names
+        ]
+        lo_all = jnp.stack(
+            [
+                jnp.asarray(domain.lo[a], jnp.float32)
+                + (
+                    me_cell[a].astype(jnp.float32) * vgrid.shape[a]
+                    + jnp.asarray(vcells[:, a])
+                )
+                * jnp.asarray(vwidths[a], jnp.float32)
+                for a in range(ndim)
+            ],
+            axis=1,
+        )  # [V, ndim]
+        rho_v = cic_deposit_vranks_planar(
+            pos_rows, mass, valid, lo_all, inv_h, vblock
+        )
         total = jnp.zeros(
             tuple(b + 1 for b in dev_block), dtype=rho_v.dtype
         )

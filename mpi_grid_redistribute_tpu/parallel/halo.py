@@ -36,6 +36,16 @@ stable pack, same append), differing only in the communication primitive:
     slabs, the ppermute becomes the grid-axis roll it would perform on the
     wire. Lets a single chip run — and honestly benchmark — the halo at
     any R, exactly like the redistribute's vrank twin.
+
+Round 4 adds the PLANAR twins (:func:`build_halo_planar` /
+:func:`build_halo_planar_vranks`): the payload rides ``[K, n]``
+component-major int32 (positions bitcast; fields bitcast — the same
+bit-pattern-safe transport as the canonical planar engines), selections
+pack with a 2-operand key sort + one flat column gather, and appends are
+contiguous ``dynamic_update_slice`` blocks instead of row scatters. Same
+ghost set, same order, bit-identical values — only the layout differs.
+The row-major engines paid 181.7 ns/ghost at config-6 shapes, dominated
+by T(8,128) tile padding on every ``[m, 3]`` buffer (BENCH_CONFIGS.md).
 """
 
 from __future__ import annotations
@@ -174,6 +184,308 @@ def _append_recv(ghost, gcount, overflow, recv, recv_cnt, H, G):
         lambda gh, rc: gh.at[idx].set(rc, mode="drop"), ghost, recv
     )
     return ghost, jnp.minimum(gcount + recv_cnt, G), overflow
+
+
+def _select_cols_for_pass(cand, cand_valid, a, dirn, lo_a, hi_a, w,
+                          at_edge, periodic, extent_a, H):
+    """PLANAR per-slab, per-(axis, direction) outgoing selection.
+
+    ``cand`` is ``[K, m]`` int32 transport (position rows bitcast); the
+    selected columns are packed with a cheap 2-operand key sort + ONE
+    flat column gather of ``H`` columns — the round-3 canonical-engine
+    recipe (the row-major :func:`_select_for_pass` gathers whole
+    ``[m, 3]`` rows, every one stored 42.7x padded in T(8,128)).
+    Returns ``(send [K, H] int32, send_cnt, overflow_inc)``.
+    """
+    D_row = lax.bitcast_convert_type(cand[a, :], jnp.float32)
+    if dirn == 1:
+        mask = cand_valid & (D_row >= hi_a - w)
+    else:
+        mask = cand_valid & (D_row < lo_a + w)
+    if not periodic:
+        mask = mask & jnp.logical_not(at_edge)
+    cnt = jnp.sum(mask.astype(jnp.int32))
+    overflow_inc = jnp.maximum(cnt - H, 0)
+    send_cnt = jnp.minimum(cnt, H)
+    m = cand.shape[1]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    _, order = jax.lax.sort(
+        (jnp.where(mask, 0, 1).astype(jnp.int32), iota),
+        num_keys=1, is_stable=True,
+    )
+    take = _take_rows(order, H)  # zero-pads when H > m, like the
+    # row-major twin (the padding columns are masked below)
+    slot_valid = jnp.arange(H, dtype=jnp.int32) < send_cnt
+    send = jnp.where(slot_valid[None, :], jnp.take(cand, take, axis=1), 0)
+    # Periodic wrap: shift the ghost coordinate into the receiver's frame
+    # (+1 across the hi wrap -> subtract extent). One-row f32 surgery.
+    shift = jnp.where(
+        at_edge & periodic,
+        -jnp.asarray(dirn, jnp.float32) * extent_a,
+        jnp.asarray(0, jnp.float32),
+    )
+    row_a = lax.bitcast_convert_type(send[a, :], jnp.float32)
+    row_a = jnp.where(slot_valid, row_a + shift, row_a)
+    send = jnp.concatenate(
+        [
+            send[:a],
+            lax.bitcast_convert_type(row_a, jnp.int32)[None, :],
+            send[a + 1 :],
+        ],
+        axis=0,
+    )
+    return send, send_cnt, overflow_inc
+
+
+def _append_recv_cols(ghost, gcount, overflow, recv, recv_cnt, H, G):
+    """Append a received planar slab to the ghost buffer — one contiguous
+    ``dynamic_update_slice`` (12.9 ns/row measured for contiguous tail
+    DUS vs ~76-85 ns/row for scatter; scripts/microbench_layout.py).
+    ``ghost`` is ``[K, G + H]``: the ``H``-column scratch tail absorbs
+    the block write when the buffer is full, so overflow drops cleanly
+    instead of clobbering earlier ghosts; callers slice ``[:, :G]`` at
+    the end."""
+    overflow = overflow + jnp.maximum(gcount + recv_cnt - G, 0)
+    start = jnp.minimum(gcount, G).astype(jnp.int32)
+    # zero the recv tail beyond recv_cnt: those columns overwrite ghost
+    # slots that the NEXT append will claim, so they must be zero (and
+    # are — _select_cols_for_pass zero-masks beyond send_cnt)
+    ghost = lax.dynamic_update_slice(ghost, recv, (jnp.int32(0), start))
+    return ghost, jnp.minimum(gcount + recv_cnt, G), overflow
+
+
+def vrank_halo_planar_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+    ndim: int = None,
+):
+    """PLANAR V-rank halo exchange on ONE device: ``[V, K, n]`` fused state.
+
+    Same 2-passes-per-axis structure, same selection predicate, same
+    append order as :func:`vrank_halo_fn` — the ghost SET and ORDER are
+    identical — but the payload is carried component-major (``K`` rows:
+    ``D`` position components first, then 32-bit fields), so no
+    narrow-minor ``[n, 3]`` buffer pays the T(8,128) tile padding, and
+    the transport is int32 (bit-pattern-safe on TPU vector units; see
+    ``exchange.vrank_redistribute_planar_fn``). Config 6 measured the
+    row-major halo at 181.7 ns/ghost — ~25x the migrate engine's per-row
+    cost for exactly this layout reason (BENCH_CONFIGS.md row 6).
+
+    Signature: ``(fused [V, K, n], count [V]) ->
+    (ghost [V, K, G], gcount [V], overflow [V])``; ``fused`` may be
+    float32 or int32 (output matches). Ghost columns beyond
+    ``gcount[v]`` are zero.
+    """
+    widths, cell_w = _validate_widths(domain, grid, halo_width)
+    H, G = pass_capacity, ghost_capacity
+    V = grid.nranks
+    nd = domain.ndim if ndim is None else ndim
+
+    def fn(fused, count):
+        if fused.ndim != 3 or fused.shape[0] != V or fused.shape[1] < nd:
+            raise ValueError(
+                f"fused must be [V={V}, K>={nd}, n], got {fused.shape}"
+            )
+        as_f32 = fused.dtype == jnp.float32
+        fi = (
+            lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+        )
+        K = fi.shape[1]
+        n = fi.shape[2]
+        valid = jnp.arange(n, dtype=jnp.int32)[None, :] < count[:, None]
+        # scratch tail of H columns absorbs full-buffer appends cleanly
+        ghost = jnp.zeros((V, K, G + H), jnp.int32)
+        gcount = jnp.zeros((V,), jnp.int32)
+        overflow = jnp.zeros((V,), jnp.int32)
+        ranks = jnp.arange(V, dtype=jnp.int32)
+        strides = grid.strides
+
+        for a in range(nd):
+            g = grid.shape[a]
+            w = jnp.asarray(widths[a], jnp.float32)
+            extent_a = jnp.asarray(domain.extent[a], jnp.float32)
+            coord_idx = (ranks // strides[a]) % g
+            lo_a = (
+                jnp.asarray(domain.lo[a], jnp.float32)
+                + coord_idx.astype(jnp.float32)
+                * jnp.asarray(cell_w[a], jnp.float32)
+            )
+            hi_a = lo_a + jnp.asarray(cell_w[a], jnp.float32)
+
+            # snapshot before this axis's passes (ghosts received on
+            # earlier axes participate; same-axis bounce is impossible)
+            cand = jnp.concatenate([fi, ghost[:, :, :G]], axis=2)
+            cand_valid = jnp.concatenate(
+                [
+                    valid,
+                    jnp.arange(G, dtype=jnp.int32)[None, :]
+                    < gcount[:, None],
+                ],
+                axis=1,
+            )
+
+            incoming = []
+            for dirn in (1, -1):
+                at_edge = coord_idx == (g - 1 if dirn == 1 else 0)
+                send, send_cnt, ov = jax.vmap(
+                    lambda c_v, cv_v, lo_v, hi_v, e_v: _select_cols_for_pass(
+                        c_v, cv_v, a, dirn, lo_v, hi_v, w, e_v,
+                        domain.periodic[a], extent_a, H,
+                    )
+                )(cand, cand_valid, lo_a, hi_a, at_edge)
+                overflow = overflow + ov
+                # the wire, as a roll on the grid-shaped vrank axis
+                recv = jnp.roll(
+                    send.reshape(grid.shape + send.shape[1:]), dirn, axis=a
+                ).reshape(send.shape)
+                recv_cnt = jnp.roll(
+                    send_cnt.reshape(grid.shape), dirn, axis=a
+                ).reshape((V,))
+                incoming.append((recv, recv_cnt))
+
+            for recv, recv_cnt in incoming:
+                ghost, gcount, overflow = jax.vmap(
+                    lambda gh_v, gc_v, ov_v, rc_v, rcnt_v: _append_recv_cols(
+                        gh_v, gc_v, ov_v, rc_v, rcnt_v, H, G
+                    )
+                )(ghost, gcount, overflow, recv, recv_cnt)
+
+        out = ghost[:, :, :G]
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        return out, gcount, overflow
+
+    return fn
+
+
+def shard_halo_planar_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+    ndim: int = None,
+):
+    """PLANAR per-shard halo exchange (runs under ``shard_map``).
+
+    The multi-device twin of :func:`vrank_halo_planar_fn`: identical
+    selection/append helpers, ``lax.ppermute`` on the wire. Signature:
+    ``(fused [K, n], count [1]) -> (ghost [K, G], gcount [1],
+    overflow [1])``.
+    """
+    widths, cell_w = _validate_widths(domain, grid, halo_width)
+    H, G = pass_capacity, ghost_capacity
+    nd = domain.ndim if ndim is None else ndim
+
+    def fn(fused, count):
+        if fused.ndim != 2 or fused.shape[0] < nd:
+            raise ValueError(
+                f"fused must be [K>={nd}, n] per shard, got {fused.shape}"
+            )
+        as_f32 = fused.dtype == jnp.float32
+        fi = (
+            lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+        )
+        n = fi.shape[1]
+        valid = jnp.arange(n, dtype=jnp.int32) < count[0]
+        ghost = jnp.zeros((fi.shape[0], G + H), jnp.int32)
+        gcount = jnp.zeros((), jnp.int32)
+        overflow = jnp.zeros((), jnp.int32)
+
+        for a, name in enumerate(grid.axis_names[:nd]):
+            g = grid.shape[a]
+            w = jnp.asarray(widths[a], jnp.float32)
+            extent_a = jnp.asarray(domain.extent[a], jnp.float32)
+            coord_idx = lax.axis_index(name).astype(jnp.int32)
+            lo_a = (
+                jnp.asarray(domain.lo[a], jnp.float32)
+                + coord_idx.astype(jnp.float32)
+                * jnp.asarray(cell_w[a], jnp.float32)
+            )
+            hi_a = lo_a + jnp.asarray(cell_w[a], jnp.float32)
+
+            cand = jnp.concatenate([fi, ghost[:, :G]], axis=1)
+            cand_valid = jnp.concatenate(
+                [valid, jnp.arange(G, dtype=jnp.int32) < gcount]
+            )
+
+            incoming = []
+            for dirn in (1, -1):
+                at_edge = coord_idx == (g - 1 if dirn == 1 else 0)
+                send, send_cnt, ov = _select_cols_for_pass(
+                    cand, cand_valid, a, dirn, lo_a, hi_a, w, at_edge,
+                    domain.periodic[a], extent_a, H,
+                )
+                overflow = overflow + ov
+                perm = [(i, (i + dirn) % g) for i in range(g)]
+                recv = lax.ppermute(send, name, perm)
+                recv_cnt = lax.ppermute(send_cnt, name, perm)
+                incoming.append((recv, recv_cnt))
+
+            for recv, recv_cnt in incoming:
+                ghost, gcount, overflow = _append_recv_cols(
+                    ghost, gcount, overflow, recv, recv_cnt, H, G
+                )
+
+        out = ghost[:, :G]
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        return out, gcount[None], overflow[None]
+
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def build_halo_planar_vranks(
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+):
+    """jit of :func:`vrank_halo_planar_fn` (single-device, [V, K, n])."""
+    widths = _as_per_axis(halo_width, domain.ndim)
+    return jax.jit(
+        vrank_halo_planar_fn(
+            domain, grid, widths, pass_capacity, ghost_capacity
+        )
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_halo_planar(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    halo_width,
+    pass_capacity: int,
+    ghost_capacity: int,
+):
+    """jit-compiled global PLANAR halo exchange over ``mesh``.
+
+    Global layout: ``fused`` ``[K, R * n_local]`` lane-sharded over the
+    grid axes (like ``exchange.build_redistribute_planar``); returns
+    ``(ghost [K, R * G], gcount [R], overflow [R])``.
+    """
+    mesh_lib.validate_mesh_for_grid(mesh, grid)
+    widths = _as_per_axis(halo_width, domain.ndim)
+    axes = grid.axis_names
+    spec_f = P(None, axes)
+    spec_c = P(axes)
+    fn = shard_halo_planar_fn(
+        domain, grid, widths, pass_capacity, ghost_capacity
+    )
+    return jax.jit(
+        shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec_f, spec_c),
+            out_specs=(spec_f, spec_c, spec_c),
+        )
+    )
 
 
 def shard_halo_fn(
@@ -390,14 +702,20 @@ def build_halo_exchange(
 
     ``pass_capacity`` / ``ghost_capacity`` default to
     :func:`default_capacities` sized from each call's per-shard row count
-    (one cached compile per distinct size); pass explicit ints to pin the
-    ghost-buffer shape across calls. Overflow past either capacity is
-    counted per shard in ``HaloResult.overflow``.
+    (one cached compile per distinct size, LRU-bounded at 16 sizes —
+    evicting an entry drops its compiled executable, so a long-lived
+    caller cycling through MANY distinct input sizes recompiles on
+    revisit; pass explicit ints to pin ONE compile for every size).
+    Overflow past either capacity is counted per shard in
+    ``HaloResult.overflow``.
     """
     mesh_lib.validate_mesh_for_grid(mesh, grid)
     _validate_widths(domain, grid, halo_width)
     spec = P(grid.axis_names)
-    built = {}  # n_local -> jitted fn (kept: discarding one drops its jit cache)
+    from collections import OrderedDict
+
+    built = OrderedDict()  # n_local -> jitted fn, LRU-bounded
+    max_builds = 16
 
     def _build(n_local: int):
         pc, gc = pass_capacity, ghost_capacity
@@ -423,8 +741,12 @@ def build_halo_exchange(
             if pass_capacity is None or ghost_capacity is None
             else 0
         )
-        if key not in built:
+        if key in built:
+            built.move_to_end(key)
+        else:
             built[key] = _build(key)
+            if len(built) > max_builds:
+                built.popitem(last=False)
         out = built[key](pos, count, *fields)
         return HaloResult(out[0], out[1], tuple(out[2:-1]), out[-1])
 

@@ -15,6 +15,8 @@ or the per-phase table in BENCH_CONFIGS.md describes a stale pipeline.
 Sanity check: phase 8 must match the FULL-step time from bench.py.
 
 Usage: python scripts/knockout_stages.py [n_local]
+       KNOCKOUT_GRID=4,4,4 python scripts/knockout_stages.py 1048576
+       (the second form is the 64M north-star shape, 64 vranks x 1M)
 """
 
 from __future__ import annotations
@@ -35,7 +37,9 @@ from mpi_grid_redistribute_tpu.ops import binning
 from mpi_grid_redistribute_tpu.parallel import migrate
 from mpi_grid_redistribute_tpu.utils import profiling
 
-GRID = (2, 2, 2)
+GRID = tuple(
+    int(x) for x in os.environ.get("KNOCKOUT_GRID", "2,2,2").split(",")
+)
 FILL = 0.9
 MIGRATION = 0.02
 K = 7
@@ -55,18 +59,20 @@ def truncated_step(domain, vgrid, C, M, n, phase):
 
         def dep_out(*arrs):
             # fold a tiny dependency into the carry so nothing is DCE'd
-            d = jnp.float32(0)
+            d = jnp.int32(0)
             for a in arrs:
-                d = d + a.ravel()[0].astype(jnp.float32) * jnp.float32(1e-38)
+                d = d + (a.ravel()[0] == jnp.asarray(7, a.dtype)).astype(
+                    jnp.int32
+                )
             return migrate.MigrateState(
-                flat.at[0, 0].add(d), free_stack, n_free
+                flat.at[0, 0].add(d.astype(flat.dtype)), free_stack, n_free
             )
 
         # ---- 1: bin (per-axis fused elementwise, matches migrate.py) ----
-        alive = flat[-1, :].reshape(V, n) > 0.5
+        alive = flat[-1, :].reshape(V, n) > 0
         dv = jnp.zeros((V * n,), jnp.int32)
         for d in range(3):
-            p = flat[d, :]
+            p = migrate._pos_row(flat, d)
             lo = jnp.asarray(domain.lo[d], p.dtype)
             ext = jnp.asarray(domain.extent[d], p.dtype)
             if domain.periodic[d]:
@@ -196,7 +202,7 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             arr_cols
         )
         cols_w = jnp.where(
-            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0.0
+            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0
         )
         flat2 = migrate._land_scatter(
             flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
@@ -236,16 +242,20 @@ def phase_bytes(V, n, M, migrants):
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 2**20
-    V = 8
-    distinct = 3
+    vgrid = ProcessGrid(GRID)
+    V = vgrid.nranks
+    distinct = int(
+        np.where(
+            np.asarray(GRID) == 1, 0, np.where(np.asarray(GRID) == 2, 1, 2)
+        ).sum()
+    ) or 1
     C = max(64, math.ceil(FILL * n * MIGRATION / distinct * 1.3))
     M = max(256, math.ceil(FILL * n * MIGRATION * 1.3))
     domain = Domain(0.0, 1.0, periodic=True)
-    vgrid = ProcessGrid(GRID)
 
     rng = np.random.default_rng(0)
-    fused = rng.random((K, V * n), dtype=np.float32)
-    fused[-1, :] = (rng.random((V * n,)) < FILL).astype(np.float32)
+    fused = rng.random((K, V * n), dtype=np.float32).view(np.int32)
+    fused[-1, :] = (rng.random((V * n,)) < FILL).astype(np.int32)
     state = migrate.init_state(
         jax.device_put(jnp.asarray(fused)), vranks=V
     )
@@ -261,8 +271,14 @@ def main():
         "| x-roofline |", file=sys.stderr,
     )
     print("|---|---|---|---|---|---|", file=sys.stderr)
-    prev = 0.0
-    for phase in range(1, 9):
+    phases = [
+        int(x)
+        for x in os.environ.get(
+            "KNOCKOUT_PHASES", "1,2,3,4,5,6,7,8"
+        ).split(",")
+    ]
+    prev = None
+    for phase in phases:
         step = truncated_step(domain, vgrid, C, M, n, phase)
 
         def make_loop(S, step=step):
@@ -271,11 +287,17 @@ def main():
                 st = migrate.MigrateState(fused, free_stack, n_free)
 
                 def body(st, _):
-                    # drift so dest_key changes each step
+                    # drift so dest_key changes each step (int32 carry,
+                    # f32 views — matches nbody.make_migrate_loop)
                     f = st.fused
-                    p = f[:3, :] + f[3:6, :] * jnp.float32(1e-4)
+                    pf = lax.bitcast_convert_type(f[:3, :], jnp.float32)
+                    vf = lax.bitcast_convert_type(f[3:6, :], jnp.float32)
+                    p = pf + vf * jnp.float32(1e-4)
                     p = binning.wrap_periodic_planar(p, domain)
-                    f = jnp.concatenate([p, f[3:, :]], axis=0)
+                    f = jnp.concatenate(
+                        [lax.bitcast_convert_type(p, jnp.int32), f[3:, :]],
+                        axis=0,
+                    )
                     st2 = step(st._replace(fused=f))
                     return st2, ()
 
@@ -289,13 +311,20 @@ def main():
         )
         mb = pb[phase] / 1e6
         roof = pb[phase] / HBM_PEAK * 1e3
-        delta = (per - prev) * 1e3
-        ratio = delta / roof if roof > 0 else float("inf")
-        print(
-            f"| {phase} | {per*1e3:7.2f} | {delta:+7.2f} | {mb:8.1f} "
-            f"| {roof:6.2f} | {ratio:6.1f} |",
-            file=sys.stderr,
-        )
+        if prev is None:
+            print(
+                f"| {phase} | {per*1e3:7.2f} | (first) | {mb:8.1f} "
+                f"| {roof:6.2f} | — |",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            delta = (per - prev) * 1e3
+            ratio = delta / roof if roof > 0 else float("inf")
+            print(
+                f"| {phase} | {per*1e3:7.2f} | {delta:+7.2f} | {mb:8.1f} "
+                f"| {roof:6.2f} | {ratio:6.1f} |",
+                file=sys.stderr, flush=True,
+            )
         prev = per
 
 

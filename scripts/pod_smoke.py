@@ -15,10 +15,15 @@ It will, over all visible real devices:
   1. build the near-cubic Cartesian mesh;
   2. run the canonical shard_map redistribute (counts + payload
      ``lax.all_to_all`` on the wire) and assert conservation + ownership;
+  2b. run the PUBLIC API (``GridRedistribute.redistribute()``, which
+     routes the round-4 planar shard_map engine) with an int32 id field
+     and assert bit-exact id conservation — this exercises the int32
+     transport (the denormal-flush fix) on real ICI;
   3. run S steps of the migrate drift loop (receiver-granted all_to_all)
      and assert conservation, zero drops, and no stall;
   4. run one auto-sized halo exchange (``ppermute``) and assert zero
-     overflow;
+     overflow, then the PLANAR halo twin and assert identical ghost
+     counts;
   5. print per-step wall timings (scan-differenced) for the migrate loop
      so the first real-ICI numbers land next to the single-chip ones in
      BENCH_CONFIGS.md.
@@ -108,6 +113,37 @@ def main() -> None:
         f"verified)", flush=True,
     )
 
+    # --- 2b: the public API -> planar shard_map engine, with a bitcast
+    # int32 id payload (the round-4 denormal-flush regression on the
+    # actual wire: ids < 2^23 are denormal f32 patterns) --------------
+    from mpi_grid_redistribute_tpu import GridRedistribute
+
+    ids = np.arange(n, dtype=np.int32)
+    rd = GridRedistribute(
+        domain, grid, mesh=mesh, capacity=cap, out_capacity=out_cap,
+        on_overflow="ignore",
+    )
+    res = rd.redistribute(pos, ids, count=count)
+    jax.block_until_ready(res.positions)
+    assert int(np.asarray(res.stats.dropped_send).sum()) == 0
+    assert int(np.asarray(res.stats.dropped_recv).sum()) == 0
+    cnt_api = np.asarray(res.count)
+    got_ids = np.concatenate(
+        [
+            np.asarray(res.fields[0])[r * out_cap : r * out_cap + cnt_api[r]]
+            for r in range(R)
+        ]
+    )
+    assert np.array_equal(np.sort(got_ids), ids), (
+        "planar API path corrupted int32 ids on the wire"
+    )
+    # and byte-identical routing vs the raw row-major engine above
+    assert np.array_equal(cnt_api, np.asarray(count_out))
+    print(
+        "public API (planar engine): OK (int32 ids bit-exact across "
+        "the wire)", flush=True,
+    )
+
     # --- 3: migrate drift loop over ICI -------------------------------
     fill, migration, S = 0.9, 0.02, 16
     from mpi_grid_redistribute_tpu.bench import common as bcommon
@@ -154,6 +190,23 @@ def main() -> None:
         s > 1 for s in shape
     )), "no ghosts on a decomposed mesh"
     print(f"halo exchange: OK ({g} ghosts, zero overflow)", flush=True)
+
+    # --- 4b: the PLANAR halo twin (the shipped fast engine) ------------
+    pc, gc = halo_lib.default_capacities(domain, grid, hw, out_cap)
+    hp = halo_lib.build_halo_planar(mesh, domain, grid, hw, pc, gc)
+    fused_g = jnp.transpose(
+        jnp.asarray(pos_out).reshape(R, out_cap, 3), (2, 0, 1)
+    ).reshape(3, R * out_cap)
+    ghost_p, gcount_p, over_p = hp(fused_g, count_out)
+    jax.block_until_ready(ghost_p)
+    assert int(np.asarray(over_p).sum()) == 0
+    assert np.array_equal(
+        np.asarray(gcount_p), np.asarray(hres.ghost_count)
+    ), "planar halo ghost counts differ from the row-major engine"
+    print(
+        f"planar halo: OK ({int(np.asarray(gcount_p).sum())} ghosts, "
+        f"counts identical to the row-major engine)", flush=True,
+    )
     print("POD SMOKE PASSED", flush=True)
 
 

@@ -213,6 +213,93 @@ def test_vrank_halo_matches_brute_force(rng, grid_shape, periodic):
         )
 
 
+def test_planar_halo_matches_rowmajor_bitlevel(rng):
+    """Round-4 planar halo: same ghost set, same ORDER, bit-identical
+    values as the row-major vrank engine — including a bitcast int32 id
+    field riding the planar fused rows."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 2048
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=2 * n_local)
+    ids = np.arange(R * n_local, dtype=np.int32)
+    res = rd.redistribute(pos, ids)
+    oc = res.positions.shape[0] // R
+    count = np.asarray(res.count)
+    w, H, G = 0.1, 2048, 4096
+    # row-major engine with the id field riding along
+    hv = halo_lib.build_halo_vranks(domain, grid, w, H, G)
+    rpos, rcount, *rfields_over = hv(
+        np.asarray(res.positions).reshape(R, oc, 3), count,
+        np.asarray(res.fields[0]).reshape(R, oc),
+    )
+    rids, rover = rfields_over
+    # planar engine: fused [V, K=4, n] = 3 pos rows + 1 bitcast id row
+    fused = np.concatenate(
+        [
+            np.asarray(res.positions).reshape(R, oc, 3).transpose(0, 2, 1),
+            np.asarray(res.fields[0])
+            .reshape(R, 1, oc)
+            .view(np.float32),
+        ],
+        axis=1,
+    )
+    hp = halo_lib.build_halo_planar_vranks(domain, grid, w, H, G)
+    gplanar, pcount, pover = hp(fused, count)
+    np.testing.assert_array_equal(np.asarray(pcount), np.asarray(rcount))
+    np.testing.assert_array_equal(np.asarray(pover), np.asarray(rover))
+    gplanar = np.asarray(gplanar)
+    for r in range(R):
+        g = int(np.asarray(rcount)[r])
+        # positions: planar rows 0-2, bit-identical and SAME ORDER
+        np.testing.assert_array_equal(
+            gplanar[r, :3, :g].T.view(np.uint32),
+            np.asarray(rpos)[r, :g].view(np.uint32),
+        )
+        # the id field: planar row 3 (bitcast) == row-major ghost field
+        np.testing.assert_array_equal(
+            gplanar[r, 3, :g].view(np.int32), np.asarray(rids)[r, :g]
+        )
+    # int32 input dtype round-trips too (transport is int32 either way)
+    gp2, pc2, _ = hp(fused.view(np.int32), count)
+    np.testing.assert_array_equal(
+        np.asarray(gp2).view(np.uint32), gplanar.view(np.uint32)
+    )
+
+
+def test_planar_halo_shard_map_matches_vranks(rng):
+    """The shard_map planar twin (ppermute wire) is bit-identical to the
+    vmapped vrank planar engine."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 64
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=2 * n_local)
+    res = rd.redistribute(pos)
+    oc = res.positions.shape[0] // R
+    count = np.asarray(res.count)
+    w, H, G = 0.1, 128, 512
+    fused_v = (
+        np.asarray(res.positions).reshape(R, oc, 3).transpose(0, 2, 1)
+    )  # [V, 3, n]
+    hp = halo_lib.build_halo_planar_vranks(domain, grid, w, H, G)
+    gv, cv, ov = hp(fused_v, count)
+    mesh = mesh_lib.make_mesh(grid)
+    hm = halo_lib.build_halo_planar(mesh, domain, grid, w, H, G)
+    fused_g = np.ascontiguousarray(fused_v.transpose(1, 0, 2)).reshape(
+        3, R * oc
+    )
+    gm, cm, om = hm(fused_g, count)
+    np.testing.assert_array_equal(np.asarray(cm), np.asarray(cv))
+    np.testing.assert_array_equal(np.asarray(om), np.asarray(ov))
+    gm = np.asarray(gm).reshape(3, R, G).transpose(1, 0, 2)
+    np.testing.assert_array_equal(
+        gm.view(np.uint32), np.asarray(gv).view(np.uint32)
+    )
+
+
 def test_vrank_halo_matches_shard_map(rng):
     """Both engines produce identical ghost multisets (bit-level rows)."""
     domain = Domain(0.0, 1.0, periodic=True)

@@ -402,6 +402,51 @@ def test_migrate_vranks_full_rotation_cycle_drains(rng, _devices):
     assert (dest_f == np.repeat(np.arange(3), n_local)).all()
 
 
+def test_migrate_vranks_cross_device_cycle_drains(rng, _devices):
+    """A pure rotation cycle of length 3 whose members live on TWO
+    devices, every vrank completely full at zero free slots — the
+    round-3 documented limitation (`no cross-device swap financing`).
+    The round-4 global cycle rescue must drain it: the forced remote
+    arrival pops the slot the member's forced departure pushed, so the
+    cycle drains one row per member per step with zero drops."""
+    dev_grid = ProcessGrid((2, 1, 1))
+    vgrid = ProcessGrid((2, 1, 1))
+    domain = Domain(0.0, 1.0, periodic=True)
+    n_local = 8
+    V, R_total = 2, 4
+    n = R_total * n_local
+    mesh = mesh_lib.make_mesh(dev_grid, devices=jax.devices()[:2])
+
+    # global rank g owns x in [g/4, (g+1)/4); ranks 0 (dev 0) and
+    # 2, 3 (dev 1) form the cycle 0 -> 2 -> 3 -> 0 (crossing devices
+    # twice); rank 1 is full and static (every row already home).
+    pos = rng.random((n, 3), dtype=np.float32)
+    cycle = {0: 2, 2: 3, 3: 0}
+    for g in range(R_total):
+        tgt = cycle.get(g, g)
+        pos[g * n_local : (g + 1) * n_local, 0] = (tgt + 0.5) / 4.0
+    vel = np.zeros((n, 3), dtype=np.float32)
+    alive = np.ones(n, dtype=bool)
+
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=0.0, capacity=n_local,
+        n_local=n_local,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, n_local + 2, vgrid=vgrid)
+    pos_f, vel_f, alive_f, stats = jax.tree.map(
+        np.asarray, loop(pos, vel, alive)
+    )
+    pos_f = nbody.planar_to_rows(pos_f, 3, mesh.size)
+    assert stats.dropped_recv.sum() == 0
+    assert alive_f.sum() == n
+    per_step = stats.backlog.sum(axis=1)
+    assert per_step[-1] == 0, f"cross-device cycle did not drain: {per_step}"
+    # every row ended on its owning global rank slab
+    full = ProcessGrid((4, 1, 1))
+    dest_f = binning.rank_of_position(pos_f, domain, full, xp=np)
+    assert (dest_f == np.repeat(np.arange(4), n_local)).all()
+
+
 def test_migrate_flat_full_rotation_cycle_drains(rng, _devices):
     """Same 3-cycle stall on the flat multi-device path: the all_gather
     cycle rescue must drain it."""

@@ -163,15 +163,45 @@ def test_detect_stall():
         return MigrateStats(sent=z, received=z, population=z, backlog=b,
                             dropped_recv=z)
 
-    # constant nonzero backlog over the window -> stall
+    # constant nonzero backlog over the window -> stall (and never drains)
     r = stats.detect_stall(mk([0, 0, 3, 3, 3, 3]), window=4)
     assert r["stalled"] == 1.0 and r["backlog_final"] == 3
+    assert r["never_drains"] == 1.0
     # draining backlog -> no stall
     r = stats.detect_stall(mk([5, 4, 3, 2, 1, 0]), window=4)
-    assert r["stalled"] == 0.0
+    assert r["stalled"] == 0.0 and r["never_drains"] == 0.0
     # zero backlog -> no stall
     r = stats.detect_stall(mk([0] * 6), window=4)
-    assert r["stalled"] == 0.0
+    assert r["stalled"] == 0.0 and r["never_drains"] == 0.0
     # too-short history -> not flagged
     r = stats.detect_stall(mk([7, 7]), window=4)
+    assert r["stalled"] == 0.0 and r["never_drains"] == 0.0
+    # OSCILLATING livelock (round-3 verdict weak item 4): backlog
+    # alternates 5<->6 and never drains — 'stalled' (constant) misses it
+    # by design, 'never_drains' catches it
+    r = stats.detect_stall(mk([0, 5, 6, 5, 6, 5]), window=4)
     assert r["stalled"] == 0.0
+    assert r["never_drains"] == 1.0
+    assert r["backlog_min"] == 5 and r["backlog_max"] == 6
+
+
+def test_rescue_disabled_above_128_ranks_warns():
+    """round-3 verdict weak item 5: the flat engine silently disabled
+    cycle rescue above 128 ranks; callers must get a runtime signal that
+    the liveness guarantee changed."""
+    from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+    from mpi_grid_redistribute_tpu.parallel import migrate
+
+    dom = Domain(0.0, 1.0, periodic=True)
+    with pytest.warns(UserWarning, match="cycle_rescue disabled"):
+        migrate.shard_migrate_fused_fn(dom, ProcessGrid((144, 1, 1)), 8)
+    # explicit opt-out stays silent
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        migrate.shard_migrate_fused_fn(
+            dom, ProcessGrid((144, 1, 1)), 8, cycle_rescue=False
+        )
+        # and small grids with rescue on stay silent too
+        migrate.shard_migrate_fused_fn(dom, ProcessGrid((2, 2, 2)), 8)
