@@ -208,12 +208,30 @@ def sorted_dest_counts(dest, n_dest: int):
     """
     n = dest.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
-    keys_sorted, order = jax.lax.sort(
-        (dest, iota), num_keys=1, is_stable=True
-    )
-    bounds = jnp.searchsorted(
-        keys_sorted, jnp.arange(n_dest + 1, dtype=jnp.int32), side="left"
-    ).astype(jnp.int32)
+    b = max(1, (n - 1).bit_length())
+    if n_dest + 1 <= (1 << (31 - b)):
+        # PACKED single-operand sort: ``(dest << b) | iota`` is unique, so
+        # an unstable one-word sort reproduces the stable two-operand
+        # (key, iota) sort bit-for-bit while moving half the bytes — the
+        # sort network is the phase-2 wall of the migrate knockout
+        # (BENCH_CONFIGS.md), and at the 64-vrank north-star the packed
+        # form fits easily (64 dests << 20-bit row index).
+        packed = jax.lax.sort((dest << b) | iota, is_stable=False)
+        order = packed & jnp.int32((1 << b) - 1)
+        bounds = jnp.searchsorted(
+            packed,
+            jnp.arange(n_dest + 1, dtype=jnp.int32) << b,
+            side="left",
+        ).astype(jnp.int32)
+    else:
+        keys_sorted, order = jax.lax.sort(
+            (dest, iota), num_keys=1, is_stable=True
+        )
+        bounds = jnp.searchsorted(
+            keys_sorted,
+            jnp.arange(n_dest + 1, dtype=jnp.int32),
+            side="left",
+        ).astype(jnp.int32)
     return order, bounds[1:] - bounds[:-1], bounds
 
 

@@ -94,6 +94,18 @@ def _stable_order(invalid: jax.Array, *subkeys: jax.Array) -> jax.Array:
     int32 — no fused ``s * K + c`` key that could overflow at scale."""
     m = invalid.shape[0]
     iota = jnp.arange(m, dtype=jnp.int32)
+    b = max(1, (m - 1).bit_length())
+    if not subkeys and b <= 30:
+        # packed single-operand sort (same trick as
+        # ``binning.sorted_dest_counts``): the 1-bit invalid flag and the
+        # iota tiebreak share one int32 word, so an unstable one-word
+        # sort reproduces the stable two-operand sort bit-for-bit while
+        # moving half the bytes.
+        packed = jax.lax.sort(
+            ((invalid != 0).astype(jnp.int32) << b) | iota,
+            is_stable=False,
+        )
+        return packed & jnp.int32((1 << b) - 1)
     operands = (invalid.astype(jnp.int32),) + subkeys + (iota,)
     out = jax.lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
     return out[-1]

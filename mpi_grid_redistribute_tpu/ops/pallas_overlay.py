@@ -55,10 +55,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-W = 2048  # lanes per streamed block (swept on-chip with the bias-encoded
-#          targets: 2.14 ms at 2048 vs 8.6 at 1024 and 14.0 at 512 at
-#          bench shapes — the one-hot compare costs P*W + m*RMAX ops and
-#          below 2048 grid-step overhead dominates)
+W = 2048  # baseline lane-block width; `overlay_scatter_planar` upgrades
+#          to 4096 whenever m divides (round-4 on-chip sweep with the
+#          double-buffered chunk DMA: 3.93 ms at 4096 vs 7.45 at 2048 on
+#          the 8.4M headline landing; 73.4 vs 74.1 ms at 64M). 2048 is
+#          kept as the fallback for m not divisible by 4096.
 RMAX = 128  # update chunk (lane-aligned)
 ROWS = 16  # plane rows per chunk: 2K halves + ones + targets <= ROWS
 
@@ -70,26 +71,53 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
     start = starts_ref[b]
     end = starts_ref[b + 1]
     acc[:] = jnp.zeros_like(acc)
+    c0 = start // rmax
+    c1 = (end + rmax - 1) // rmax
+
+    # DOUBLE-BUFFERED chunk DMA: the per-chunk start();wait() pair put a
+    # full HBM round-trip latency on every chunk's critical path — at the
+    # 64M north-star (16k blocks x ~2 chunks) that latency is the bulk of
+    # the kernel's 15x-over-roofline per-block overhead. Chunk c+1's copy
+    # is now in flight while chunk c computes.
+    def dma(c, slot):
+        return pltpu.make_async_copy(
+            planes_hbm.at[:, pl.ds(c * rmax, rmax)],
+            planes_scr.at[slot],
+            sems.at[slot],
+        )
+
+    @pl.when(c0 < c1)
+    def _():
+        dma(c0, c0 % 2).start()
 
     def chunk_body(c, _):
-        j0 = c * rmax
-        dma = pltpu.make_async_copy(
-            planes_hbm.at[:, pl.ds(j0, rmax)], planes_scr, sems.at[0]
-        )
-        dma.start()
-        dma.wait()
+        slot = c % 2
+
+        @pl.when(c + 1 < c1)
+        def _():
+            dma(c + 1, 1 - slot).start()
+
+        dma(c, slot).wait()
+        chunk = planes_scr[slot]
         # targets row -> sublane-major [RMAX, 1] for the lane compare;
         # targets travel as bitcast (int + 0x3F800000) patterns: a raw
         # int bitcast is a DENORMAL f32 for targets < 2^23 and the TPU
         # vector units flush denormals to zero on any copy (measured:
         # 1.28M corrupted targets of 58.7M at the first on-chip run);
         # the bias keeps every pattern a normal float for ints < 2^30
-        tgt_scr[:] = planes_scr[ROWS - 1 : ROWS, :].T
+        tgt_scr[:] = chunk[ROWS - 1 : ROWS, :].T
         tgt = (
             jax.lax.bitcast_convert_type(tgt_scr[:], jnp.int32)
             - jnp.int32(0x3F800000)
             - base
         )  # [RMAX, 1]
+        # Dense one-hot compare + ONE matmul. A factored Kronecker form
+        # (e_t = e_hi (x) e_lo, one masked [ROWS, rmax] @ [rmax, 128]
+        # per 128-lane slice — 25x less one-hot VPU build) was measured
+        # and REJECTED: 7.0-9.1 ms vs 3.9 ms at the 8.4M headline — the
+        # w/128 small matmuls + per-slice acc updates cost more than the
+        # dense compare they replace (Mosaic handles one wide matmul
+        # far better than 32 thin ones).
         onehot = (
             tgt
             == jax.lax.broadcasted_iota(jnp.int32, (rmax, w), 1)
@@ -97,14 +125,12 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
         # neighbors' and sentinel targets miss every lane: no bounds
         # masking needed. Unique targets => plain accumulation.
         acc[:] += jnp.dot(
-            planes_scr[:], onehot,
+            chunk, onehot,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
         return _
 
-    c0 = start // rmax
-    c1 = (end + rmax - 1) // rmax
     jax.lax.fori_loop(c0, c1, chunk_body, None)
 
     # reassemble 32-bit words from the exact-integer half-planes
@@ -140,16 +166,16 @@ def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX):
             (k, m), flat.dtype, vma=jax.typeof(flat).vma
         ),
         scratch_shapes=[
-            pltpu.VMEM((ROWS, rmax), jnp.float32),  # planes chunk
+            pltpu.VMEM((2, ROWS, rmax), jnp.float32),  # 2 chunk buffers
             pltpu.VMEM((rmax, 1), jnp.float32),  # transposed targets
             pltpu.VMEM((ROWS, w), jnp.float32),  # overlay accumulator
-            pltpu.SemaphoreType.DMA((1,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
     )(starts, planes, flat)
 
 
-def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
+def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
                            rmax=RMAX):
     """Drop-in for ``flat.at[:, targets].set(cols, mode='drop')``.
 
@@ -162,6 +188,14 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=W,
     """
     k, m = flat.shape
     p = targets.shape[0]
+    if w is None:
+        # with the double-buffered chunk DMA, W=4096 wins at every
+        # measured size: 3.93 ms vs 7.45 at 2048 on the 8.4M headline
+        # landing (scripts/microbench_overlay.py) and 75.7 vs 86.7 ms at
+        # the 64M north-star (scripts/microbench_overlay_ns.py, single-
+        # buffered; the db kernel is re-swept there too). An explicit
+        # ``w`` is honored verbatim (the microbench sweeps depend on it).
+        w = 4096 if m % 4096 == 0 else W
     if (
         m % w
         or m >= (1 << 30)  # target encoding bound (never denormal/NaN)

@@ -207,12 +207,8 @@ def _select_cols_for_pass(cand, cand_valid, a, dirn, lo_a, hi_a, w,
     cnt = jnp.sum(mask.astype(jnp.int32))
     overflow_inc = jnp.maximum(cnt - H, 0)
     send_cnt = jnp.minimum(cnt, H)
-    m = cand.shape[1]
-    iota = jnp.arange(m, dtype=jnp.int32)
-    _, order = jax.lax.sort(
-        (jnp.where(mask, 0, 1).astype(jnp.int32), iota),
-        num_keys=1, is_stable=True,
-    )
+    order = _stable_order(jnp.logical_not(mask))  # shared with the
+    # row-major twin: ONE copy of the bit-sensitive ordering contract
     take = _take_rows(order, H)  # zero-pads when H > m, like the
     # row-major twin (the padding columns are masked below)
     slot_valid = jnp.arange(H, dtype=jnp.int32) < send_cnt

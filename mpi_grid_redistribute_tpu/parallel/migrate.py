@@ -335,7 +335,13 @@ def _segment_of_auto(k: jax.Array, cum: jax.Array) -> jax.Array:
     step (round-2 advisor). Identical semantics on duplicate boundaries
     (empty segments resolve past the run of duplicates) and for
     ``k >= cum[-1]`` (returns n_segs)."""
-    if cum.shape[0] <= 33:
+    if cum.shape[0] <= 129:
+        # comparison-count: O(n_segs) VECTORIZED work per query — cheap up
+        # to O(128) tables. The merge-sort searchsorted below introduces a
+        # sort op that XLA can neither slice through nor hoist; the
+        # round-4 north-star knockout charged +56 ms to the vmapped
+        # method="sort" lowering at V=64 (65-entry tables) where the
+        # comparison-count costs ~100M vectorized compares (~2-4 ms).
         return _segment_of(k, cum)
     return (
         jnp.searchsorted(cum, k, side="right", method="sort").astype(

@@ -68,6 +68,11 @@ def truncated_step(domain, vgrid, C, M, n, phase):
                 flat.at[0, 0].add(d.astype(flat.dtype)), free_stack, n_free
             )
 
+        # ---- 0: nothing past the loop-body drift (isolates the carry
+        # concat + wrap cost charged to phase 1's "first" row) ----------
+        if phase == 0:
+            return dep_out(flat)
+
         # ---- 1: bin (per-axis fused elementwise, matches migrate.py) ----
         alive = flat[-1, :].reshape(V, n) > 0
         dv = jnp.zeros((V * n,), jnp.int32)
@@ -229,6 +234,8 @@ def phase_bytes(V, n, M, migrants):
     latency/serialization bound, not a bandwidth wall."""
     f32 = 4
     return {
+        0: (2 * K + 3) * V * n * f32,      # drift: state r/w + pos rows
+
         1: (3 + 3 + 1 + 1) * V * n * f32,  # read pos+vel+alive, write key
         2: 4 * V * n * f32,                # sort in/out of (key, iota)
         3: 0,                              # [V, V] tables

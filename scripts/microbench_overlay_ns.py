@@ -1,0 +1,107 @@
+"""On-chip: overlay landing at the 64M NORTH-STAR shape, decomposed.
+
+The round-4 knockout at 64 vranks x 1M rows attributes +148 ms to the
+landing phase (vs +12.1 at the 8-vrank headline — 12x for 8x the
+migrants). This script decomposes the overlay path at that shape:
+
+  1. XLA-side prep: payload sort by target + half-plane build +
+     per-block searchsorted;
+  2. the Pallas kernel alone (planes/starts precomputed);
+  3. the full drop-in (prep + kernel), W swept;
+  4. XLA column scatter baseline.
+
+Usage: python scripts/microbench_overlay_ns.py [m_cols] [p_updates]
+(defaults 64M / 1.57M — the north-star landing shape)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.ops import pallas_overlay
+from mpi_grid_redistribute_tpu.utils import profiling
+
+K = 7
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 64 * (1 << 20)
+    p = int(sys.argv[2]) if len(sys.argv) > 2 else 64 * 24_537
+    r = np.random.default_rng(0)
+    flat = r.integers(-(2**31), 2**31 - 1, size=(K, m), dtype=np.int32)
+    targets = r.choice(m, size=p, replace=False).astype(np.int32)
+    targets[r.random(p) < 0.23] = m  # plan padding tail -> drop sentinel
+    cols = r.integers(-(2**31), 2**31 - 1, size=(K, p), dtype=np.int32)
+
+    fd = jax.device_put(jnp.asarray(flat))
+    td = jax.device_put(jnp.asarray(targets))
+    cd = jax.device_put(jnp.asarray(cols))
+    print(f"m={m} cols, p={p} plan entries", flush=True)
+
+    def timed(name, fn, *args):
+        def make_loop(S):
+            @jax.jit
+            def loop(*a):
+                def body(acc, _):
+                    out = fn(*a[1:], acc)
+                    return out, ()
+
+                acc, _ = lax.scan(body, a[0], None, length=S)
+                return acc
+
+            return loop
+
+        per, _, _ = profiling.scan_time_per_step(
+            make_loop, args, s1=2, s2=6
+        )
+        print(f"  {name}: {per*1e3:8.2f} ms", flush=True)
+        return per
+
+    # 4: XLA column scatter baseline
+    def xla_scatter(t, c, f):
+        return f.at[:, t].set(c, mode="drop")
+
+    timed("xla column scatter", xla_scatter, fd, td, cd)
+
+    # 1: prep only (sort + planes + searchsorted), dependency-folded
+    for w in (2048, 4096, 8192):
+        def prep(t, c, f, w=w):
+            sentinel = jnp.int32(m)
+            tgt = jnp.where((t < 0) | (t >= m), sentinel, t)
+            operands = (tgt,) + tuple(c[i] for i in range(K))
+            s = lax.sort(operands, num_keys=1, is_stable=False)
+            ts = s[0]
+            edges = jnp.arange(0, m + w, w, dtype=jnp.int32)
+            starts = jnp.searchsorted(
+                ts, edges, side="left", method="sort"
+            ).astype(jnp.int32)
+            words = lax.bitcast_convert_type(
+                jnp.stack(s[1:], axis=0), jnp.uint32
+            )
+            hi = (words >> 16).astype(jnp.float32)
+            # fold everything into the carry so nothing is DCE'd
+            return f.at[0, 0].add(
+                starts[-1] + hi[0, 0].astype(jnp.int32)
+            )
+
+        timed(f"prep only (sort+planes+starts) W={w}", prep, fd, td, cd)
+
+    # 3: full drop-in, W swept
+    for w in (2048, 4096, 8192, 16384):
+        if m % w:
+            continue
+
+        def full(t, c, f, w=w):
+            return pallas_overlay.overlay_scatter_planar(f, t, c, w=w)
+
+        timed(f"overlay full W={w}", full, fd, td, cd)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""On-chip: plan-phase formulations at the 64M north-star shape.
+
+Round-4 knockout at 64 vranks: phase 4 (vacated plan) +56.1 ms, phase 6
+(landing plan) +30.8 ms, phase 8 (stack update) +12.1 ms — all thousands
+of x over their logical-byte rooflines. Candidate causes measured here:
+
+  A. `_segment_of_auto` switches to vmapped searchsorted(method="sort")
+     once cum has > 33 entries — exactly at V=64 (65-entry tables); the
+     V=8 headline still used the vectorized comparison-count.
+  B. vmapped per-vrank gathers `order[pos]` / `take_along_axis` vs ONE
+     flat `jnp.take` with globally-indexed columns.
+
+Usage: python scripts/microbench_plans_ns.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.parallel import migrate
+from mpi_grid_redistribute_tpu.utils import profiling
+
+V, n, M = 64, 1 << 20, 24_537
+
+
+def timed(name, fn, *args):
+    def make_loop(S):
+        @jax.jit
+        def loop(*a):
+            def body(acc, _):
+                return fn(*a[1:], acc), ()
+
+            acc, _ = lax.scan(body, a[0], None, length=S)
+            return acc
+
+        return loop
+
+    per, _, _ = profiling.scan_time_per_step(make_loop, args, s1=2, s2=10)
+    print(f"  {name}: {per*1e3:8.2f} ms", flush=True)
+    return per
+
+
+def main():
+    r = np.random.default_rng(0)
+    # realistic inputs: per-vrank sorted-order permutations, allowed
+    # counts summing to ~M*0.8, free stacks
+    order = np.stack([r.permutation(n).astype(np.int32) for _ in range(V)])
+    allowed = r.integers(0, 2 * M // V, size=(V, V)).astype(np.int32)
+    loc_starts = np.cumsum(
+        np.concatenate([np.zeros((V, 1), np.int32), allowed], axis=1)[:, :-1],
+        axis=1,
+    ).astype(np.int32)
+    free_stack = np.stack(
+        [r.permutation(n).astype(np.int32) for _ in range(V)]
+    )
+    n_free = r.integers(M, n // 2, size=V).astype(np.int32)
+    n_sent = np.minimum(allowed.sum(1), M).astype(np.int32)
+    n_in = np.minimum(allowed.sum(0), M).astype(np.int32)
+
+    od = jax.device_put(jnp.asarray(order))
+    ad = jax.device_put(jnp.asarray(allowed))
+    ld = jax.device_put(jnp.asarray(loc_starts))
+    fsd = jax.device_put(jnp.asarray(free_stack))
+    nfd = jax.device_put(jnp.asarray(n_free))
+    nsd = jax.device_put(jnp.asarray(n_sent))
+    nid = jax.device_put(jnp.asarray(n_in))
+    acc0 = jax.device_put(jnp.zeros((8, 128), jnp.int32))
+
+    def dep(acc, *arrs):
+        # consume the FULL array (sum reduction): a 1-element probe lets
+        # XLA slice through gathers and DCE the work being measured
+        for a in arrs:
+            acc = acc.at[0, 0].add(jnp.sum(a.astype(jnp.int32)))
+        return acc
+
+    # ---- phase 4: vacated plan --------------------------------------
+    def plan_current(ls, al, o, acc):
+        vac, _ = jax.vmap(lambda ss, sc, oo: migrate._plan_rows(ss, sc, oo, M))(
+            ls, al, o
+        )
+        return dep(acc, vac)
+
+    def plan_segof(ls, al, o, acc):
+        # comparison-count segment_of + flat take
+        j = jnp.arange(M, dtype=jnp.int32)
+        cum = jnp.concatenate(
+            [jnp.zeros((V, 1), jnp.int32), jnp.cumsum(al, axis=1)], axis=1
+        )
+        seg = jnp.clip(
+            jax.vmap(lambda c: migrate._segment_of(j, c))(cum), 0, V - 1
+        )  # [V, M]
+        pos = jnp.take_along_axis(ls, seg, axis=1) + (
+            j[None, :] - jnp.take_along_axis(cum, seg, axis=1)
+        )
+        gidx = (
+            jnp.arange(V, dtype=jnp.int32)[:, None] * n
+            + jnp.clip(pos, 0, n - 1)
+        )
+        vac = jnp.take(o.reshape(-1), gidx.reshape(-1)).reshape(V, M)
+        return dep(acc, vac)
+
+    print("phase 4 (vacated plan):", flush=True)
+    timed("current (_segment_of_auto + vmapped order[pos])", plan_current,
+          acc0, ld, ad, od)
+    timed("segof-compare + flat take", plan_segof, acc0, ld, ad, od)
+
+    # ---- phase 6: landing plan --------------------------------------
+    vac0 = jax.device_put(
+        jnp.asarray(r.integers(0, n, size=(V, M)).astype(np.int32))
+    )
+
+    def land_current(vac, nin, nsent, nf, fs, acc):
+        k_idx = jnp.arange(M, dtype=jnp.int32)
+
+        def lp(vacv, ninv, nsentv, nfv):
+            n_pop = jnp.clip(ninv - nsentv, 0, nfv)
+            pop_idx = jnp.clip(nfv - 1 - (k_idx - nsentv), 0, n - 1)
+            target = jnp.where(
+                k_idx < jnp.minimum(ninv, nsentv),
+                vacv,
+                jnp.where(
+                    (k_idx >= nsentv) & (k_idx < nsentv + n_pop),
+                    jnp.zeros((), jnp.int32),
+                    jnp.where(
+                        (k_idx >= ninv) & (k_idx < nsentv), vacv, n
+                    ),
+                ),
+            )
+            return target, n_pop, pop_idx
+
+        targets, n_pop, pop_idx = jax.vmap(lp)(vac, nin, nsent, nf)
+        pops = jnp.take_along_axis(fs, pop_idx, axis=1)
+        use_pop = (k_idx[None, :] >= nsent[:, None]) & (
+            k_idx[None, :] < (nsent + n_pop)[:, None]
+        )
+        targets = jnp.where(use_pop, pops, targets)
+        return dep(acc, targets)
+
+    def land_flat(vac, nin, nsent, nf, fs, acc):
+        k_idx = jnp.arange(M, dtype=jnp.int32)[None, :]
+        n_pop = jnp.clip(nin - nsent, 0, nf)[:, None]
+        pop_idx = jnp.clip(
+            nf[:, None] - 1 - (k_idx - nsent[:, None]), 0, n - 1
+        )
+        gpop = jnp.arange(V, dtype=jnp.int32)[:, None] * n + pop_idx
+        pops = jnp.take(fs.reshape(-1), gpop.reshape(-1)).reshape(V, M)
+        nin_b, nsent_b = nin[:, None], nsent[:, None]
+        target = jnp.where(
+            k_idx < jnp.minimum(nin_b, nsent_b),
+            vac,
+            jnp.where(
+                (k_idx >= nsent_b) & (k_idx < nsent_b + n_pop),
+                pops,
+                jnp.where(
+                    (k_idx >= nin_b) & (k_idx < nsent_b), vac, n
+                ),
+            ),
+        )
+        return dep(acc, target)
+
+    print("phase 6 (landing plan):", flush=True)
+    timed("current (vmapped + take_along_axis)", land_current,
+          acc0, vac0, nid, nsd, nfd, fsd)
+    timed("broadcast + flat take", land_flat,
+          acc0, vac0, nid, nsd, nfd, fsd)
+
+    # ---- phase 8: stack update --------------------------------------
+    npop0 = jax.device_put(
+        jnp.asarray(r.integers(0, M // 2, size=V).astype(np.int32))
+    )
+    npush0 = jax.device_put(
+        jnp.asarray(r.integers(0, M // 2, size=V).astype(np.int32))
+    )
+
+    def stack_current(fs, nf, npop, npush, vac, nin, acc):
+        fs2, nf2 = jax.vmap(migrate._stack_push_pop)(
+            fs, nf, npop, npush, vac, nin
+        )
+        return dep(acc, fs2, nf2)
+
+    print("phase 8 (stack update):", flush=True)
+    timed("current (vmapped window blend)", stack_current,
+          acc0, fsd, nfd, npop0, npush0, vac0, nid)
+
+
+if __name__ == "__main__":
+    main()
