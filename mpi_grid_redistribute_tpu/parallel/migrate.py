@@ -417,7 +417,17 @@ def _stack_push_pop(free_stack, n_free, n_pop, n_push, vacated, n_in):
     window = lax.dynamic_slice(free_stack, (win_start,), (W,))
     rel = n_free - win_start  # stack head position inside the window
     w_idx = jnp.arange(W, dtype=jnp.int32)
-    pushes = vacated[jnp.clip(n_in + (w_idx - rel), 0, P - 1)]
+    # affine index (w + n_in - rel): one dynamic slice of the padded
+    # plan replaces a [W]-element gather (out-of-use entries read the
+    # zero pads and are masked below)
+    buf = jnp.concatenate(
+        [
+            jnp.zeros((W,), vacated.dtype),
+            vacated,
+            jnp.zeros((W,), vacated.dtype),
+        ]
+    )
+    pushes = lax.dynamic_slice(buf, (n_in - rel + W,), (W,))
     window = jnp.where(
         (w_idx >= rel) & (w_idx < rel + n_push), pushes, window
     )
@@ -676,6 +686,75 @@ def _plan_rows(seg_starts, seg_counts, order, length: int):
     return order[jnp.clip(pos, 0, n - 1)], cum[-1]
 
 
+def _plan_rows_batched(seg_starts, seg_counts, order, length: int):
+    """Batched :func:`_plan_rows` over a leading vrank axis, with every
+    gather LINEARIZED into one wide-minor ``jnp.take(..., axis=1)``.
+
+    ``vmap(_plan_rows)`` lowers its ``order[pos]`` to a batched gather
+    that costs ~33 ns/element on this stack (round-4 north-star knockout:
+    +52.5 ms for a [64, 24537] plan), while the same elements through a
+    flat ``[1, V*n]`` axis-1 take cost ~1 ns/element (the arrival
+    gather's pattern, phase 5). Inputs: ``seg_starts``/``seg_counts``
+    [V, S], ``order`` [V, n]; returns ``(vacated [V, length],
+    totals [V])``.
+    """
+    V, S = seg_counts.shape
+    n = order.shape[1]
+    cum = jnp.concatenate(
+        [
+            jnp.zeros((V, 1), jnp.int32),
+            jnp.cumsum(seg_counts, axis=1).astype(jnp.int32),
+        ],
+        axis=1,
+    )  # [V, S+1]
+    j = jnp.arange(length, dtype=jnp.int32)
+    # TELESCOPED segment lookup: with mask[v, j, s] = (j >= cum[v, s+1]),
+    # seg = sum_s mask, and any gather from a per-segment table telescopes
+    # through the same mask — f[seg[j]] = f[0] + sum_s mask * (f[s+1] -
+    # f[s]). One [V, length, S] masked reduction replaces the 65-entry
+    # table takes, which cost ~6 ns/element on this stack (round-4
+    # diagnostic: +19 ms for two takes at the 64-vrank north-star).
+    # Values stay < n = 2^20 << 2^24, exact in f32.
+    mask = (
+        cum[:, None, 1:] <= j[None, :, None]
+    ).astype(jnp.float32)  # [V, length, S]
+    d_start = jnp.diff(
+        jnp.concatenate(
+            [seg_starts, seg_starts[:, -1:]], axis=1
+        ).astype(jnp.float32),
+        axis=1,
+    )  # [V, S]: seg_starts[s+1] - seg_starts[s] (last diff 0 = clamp)
+    d_cum = jnp.diff(cum[:, :-1].astype(jnp.float32), axis=1)
+    d_cum = jnp.concatenate(
+        [d_cum, jnp.zeros((V, 1), jnp.float32)], axis=1
+    )  # [V, S]: cum[s+1] - cum[s], clamped at the last segment
+    # HIGHEST precision: the default TPU matmul rounds operands to bf16
+    # (8-bit mantissa) — diffs reach 2^20 and must multiply exactly
+    starts_g = (
+        seg_starts[:, :1].astype(jnp.float32)
+        + jnp.einsum(
+            "vjs,vs->vj", mask, d_start,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    ).astype(jnp.int32)
+    cum_g = (
+        jnp.einsum(
+            "vjs,vs->vj", mask, d_cum,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    ).astype(jnp.int32)  # cum[:, 0] == 0
+    pos = starts_g + (j[None, :] - cum_g)
+    v_off = jnp.arange(V, dtype=jnp.int32)[:, None]
+    # 1-D index vector: the fast axis-1 take lowering keys off flat
+    # indices (2-D index arrays fall back to the ~33 ns/elem gather)
+    vac = jnp.take(
+        order.reshape(1, -1),
+        (v_off * n + jnp.clip(pos, 0, n - 1)).reshape(-1),
+        axis=1,
+    ).reshape(V, length)
+    return vac, cum[:, -1]
+
+
 def balanced_assignment(cell_loads, n_ranks: int) -> tuple:
     """Static cell -> rank map equalizing per-rank load (host-side, LPT).
 
@@ -862,8 +941,17 @@ def shard_migrate_vranks_fn(
                 dest_v = dest_v + cell_d * jnp.int32(full_grid.strides[d])
             else:
                 vs = vgrid.shape[d]
-                dest_dev = dest_dev + (cell_d // vs) * dev_grid.strides[d]
-                dest_v = dest_v + (cell_d % vs) * vgrid.strides[d]
+                if dev_grid.shape[d] == 1:
+                    # single device slab on this axis: cell_d < vs
+                    # statically, so the // and % are identities — int32
+                    # div/mod have no native VPU lowering and cost real
+                    # passes over [V*n] (round-4 phase-1 attribution)
+                    dest_v = dest_v + cell_d * vgrid.strides[d]
+                else:
+                    dest_dev = (
+                        dest_dev + (cell_d // vs) * dev_grid.strides[d]
+                    )
+                    dest_v = dest_v + (cell_d % vs) * vgrid.strides[d]
         if assignment is not None:
             # one gather from the tiny [n_cells] table: cell -> global rank
             g = jnp.take(
@@ -1108,9 +1196,9 @@ def shard_migrate_vranks_fn(
         else:
             seg_starts = loc_starts
             seg_counts = allowed
-        vacated, _tot = jax.vmap(
-            lambda ss, sc, o: _plan_rows(ss, sc, o, P)
-        )(seg_starts, seg_counts, order)  # [V, P]
+        vacated, _tot = _plan_rows_batched(
+            seg_starts, seg_counts, order, P
+        )  # [V, P] (linearized takes — vmapped gathers cost ~33 ns/elem)
 
         # ---- local arrivals: one column gather sized to the budget ----
         # dst w's arrivals: sources in order, first allowed[s, w] rows of
@@ -1155,7 +1243,32 @@ def shard_migrate_vranks_fn(
         targets, n_pop, pop_idx = jax.vmap(land_plan)(
             vacated, n_in_local, n_sent, n_free
         )
-        pops = jnp.take_along_axis(free_stack, pop_idx, axis=1)
+        # The pop positions are an AFFINE sequence (stack head downward:
+        # nf-1, nf-2, ... for k in [nsent, nsent+n_pop)), so the gather
+        # is really a reversed contiguous window: slice it, reverse it,
+        # and shift it into k-alignment with one more dynamic slice —
+        # [P]-sized copies instead of a V*P-element random gather.
+        W2 = min(P, n)  # window length (P can exceed n in tiny tests)
+
+        def pops_window(fs_v, nf, nsent):
+            start = jnp.clip(nf - W2, 0, n - W2)
+            win_rev = lax.dynamic_slice(fs_v, (start,), (W2,))[::-1]
+            # win_rev[i] = fs_v[start + W2 - 1 - i]; want
+            # pops[k] = fs_v[nf - 1 - (k - nsent)] = win_rev[k + s],
+            # s = start + W2 - nf - nsent  (every in-use k lands inside
+            # the window; out-of-use entries read the zero pads and are
+            # masked by use_pop below)
+            s = start + W2 - nf - nsent
+            buf = jnp.concatenate(
+                [
+                    jnp.zeros((P,), fs_v.dtype),
+                    win_rev,
+                    jnp.zeros((P,), fs_v.dtype),
+                ]
+            )
+            return lax.dynamic_slice(buf, (s + P,), (P,))
+
+        pops = jax.vmap(pops_window)(free_stack, n_free, n_sent)
         use_pop = (k_idx[None, :] >= n_sent[:, None]) & (
             k_idx[None, :] < (n_sent + n_pop)[:, None]
         )

@@ -89,7 +89,9 @@ def truncated_step(domain, vgrid, C, M, n, phase):
                 0,
                 vgrid.shape[d] - 1,
             )
-            dv = dv + (cell_d % vgrid.shape[d]) * vgrid.strides[d]
+            # no mod: cell_d < shape[d] statically (int32 mod has no
+            # native VPU lowering — matches the Dev==1 engine elision)
+            dv = dv + cell_d * vgrid.strides[d]
         dv = dv.reshape(V, n)
         staying = dv == my_v[:, None]
         dest_key = jnp.where(alive & ~staying, dv, R_total).astype(
@@ -144,9 +146,42 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             return dep_out(allowed, n_sent, n_in_local)
 
         # ---- 4: vacated-slot plan ---------------------------------------
-        vacated, _tot = jax.vmap(
-            lambda ss, sc, o: migrate._plan_rows(ss, sc, o, P)
-        )(loc_starts, allowed, order)
+        # diagnostic sub-phases: 41 = segment lookup only, 42 = plan
+        # arithmetic without the final order gather
+        if phase in (41, 42):
+            S = V
+            cum = jnp.concatenate(
+                [
+                    jnp.zeros((V, 1), jnp.int32),
+                    jnp.cumsum(allowed, axis=1).astype(jnp.int32),
+                ],
+                axis=1,
+            )
+            jj = jnp.arange(P, dtype=jnp.int32)
+            seg = jnp.sum(
+                (cum[:, None, 1:] <= jj[None, :, None]),
+                axis=-1,
+                dtype=jnp.int32,
+            )
+            seg = jnp.clip(seg, 0, S - 1)
+            if phase == 41:
+                return dep_out(seg)
+            v_off = jnp.arange(V, dtype=jnp.int32)[:, None]
+            tab = jnp.concatenate(
+                [loc_starts, cum[:, :-1]], axis=1
+            ).reshape(1, -1)
+            flat_idx = v_off * (2 * S) + seg
+            starts_g = jnp.take(
+                tab, flat_idx.reshape(-1), axis=1
+            ).reshape(V, P)
+            cum_g = jnp.take(
+                tab, flat_idx.reshape(-1) + S, axis=1
+            ).reshape(V, P)
+            pos = starts_g + (jj[None, :] - cum_g)
+            return dep_out(jnp.clip(pos, 0, n - 1))
+        vacated, _tot = migrate._plan_rows_batched(
+            loc_starts, allowed, order, P
+        )
         if phase == 4:
             return dep_out(vacated)
 
@@ -190,7 +225,22 @@ def truncated_step(domain, vgrid, C, M, n, phase):
         targets, n_pop, pop_idx = jax.vmap(land_plan)(
             vacated, n_in_local, n_sent, n_free
         )
-        pops = jnp.take_along_axis(free_stack, pop_idx, axis=1)
+        W2 = min(P, n)
+
+        def pops_window(fs_v, nf, nsent):
+            start = jnp.clip(nf - W2, 0, n - W2)
+            win_rev = lax.dynamic_slice(fs_v, (start,), (W2,))[::-1]
+            s = start + W2 - nf - nsent
+            buf = jnp.concatenate(
+                [
+                    jnp.zeros((P,), fs_v.dtype),
+                    win_rev,
+                    jnp.zeros((P,), fs_v.dtype),
+                ]
+            )
+            return lax.dynamic_slice(buf, (s + P,), (P,))
+
+        pops = jax.vmap(pops_window)(free_stack, n_free, n_sent)
         use_pop = (k_idx[None, :] >= n_sent[:, None]) & (
             k_idx[None, :] < (n_sent + n_pop)[:, None]
         )
@@ -209,6 +259,8 @@ def truncated_step(domain, vgrid, C, M, n, phase):
         cols_w = jnp.where(
             (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0
         )
+        if phase == 71:  # diagnostic: landing inputs built, scatter off
+            return dep_out(cols_w, gtargets)
         flat2 = migrate._land_scatter(
             flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
             migrate._resolve_scatter_impl(None),
@@ -240,9 +292,12 @@ def phase_bytes(V, n, M, migrants):
         2: 4 * V * n * f32,                # sort in/out of (key, iota)
         3: 0,                              # [V, V] tables
         4: 3 * V * M * f32,                # plan vectors + order gather
+        41: V * M * f32,                   # diagnostic: segment lookup
+        42: 2 * V * M * f32,               # diagnostic: plan sans gather
         5: (K + 1) * V * M * f32 + K * V * M * f32,  # gather in+out
         6: 4 * V * M * f32,                # plan vectors
         7: (K + 1) * V * M * f32,          # scatter writes + targets
+        71: (K + 1) * V * M * f32,         # diagnostic: inputs, no scatter
         8: 2 * V * M * f32,                # stack windows
     }
 
