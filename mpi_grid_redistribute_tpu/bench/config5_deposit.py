@@ -32,7 +32,10 @@ def run(n_local: int = None, mesh_cells: int = 128,
 
     scale = float(os.environ.get("BENCH_SCALE", 1.0))
     n_local = n_local or max(1 << 12, int(scale * (1 << 20)))
-    grid_shape = (2, 2, 2)
+    grid_shape = tuple(
+        int(x)
+        for x in os.environ.get("BENCH_GRID", "2,2,2").split(",")
+    )  # BENCH_GRID=4,4,4 BENCH_SCALE=1 = the 64M north-star shape
     dev_grid, vgrid, mesh, n_chips = common.pick_layout(grid_shape)
     R = math.prod(grid_shape)
     domain = Domain(0.0, 1.0, periodic=True)
