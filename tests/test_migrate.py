@@ -651,3 +651,95 @@ def test_migrate_assignment_validation(rng, _devices):
     cfg2 = _dc.replace(cfg, deposit_shape=(4, 4, 4))
     with pytest.raises(ValueError, match="deposit"):
         nbody.make_migrate_loop(cfg2, mesh, 1, vgrid=vgrid)
+
+
+def test_plan_rows_batched_matches_vmapped(rng):
+    """The telescoped/flat-take batched plan (round 4) must reproduce the
+    per-vrank ``_plan_rows`` bit-for-bit — it feeds the vacated-slot plan
+    of the vrank engine, whose landing correctness rides on it."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.parallel import migrate
+
+    for V, S, n, length in [(4, 4, 257, 64), (8, 8, 1024, 300),
+                            (3, 7, 50, 128)]:
+        seg_counts = rng.integers(0, 30, size=(V, S)).astype(np.int32)
+        seg_starts = np.cumsum(
+            np.concatenate(
+                [rng.integers(0, 5, size=(V, 1)), seg_counts[:, :-1]],
+                axis=1,
+            ),
+            axis=1,
+        ).astype(np.int32)
+        order = np.stack(
+            [rng.permutation(n).astype(np.int32) for _ in range(V)]
+        )
+        ref_v, ref_t = jax.vmap(
+            lambda ss, sc, o: migrate._plan_rows(ss, sc, o, length)
+        )(jnp.asarray(seg_starts), jnp.asarray(seg_counts),
+          jnp.asarray(order))
+        got_v, got_t = migrate._plan_rows_batched(
+            jnp.asarray(seg_starts), jnp.asarray(seg_counts),
+            jnp.asarray(order), length
+        )
+        # entries beyond each vrank's total are clipped junk by contract
+        # (callers mask by j < total); compare only the meaningful prefix
+        ref_v, got_v = np.asarray(ref_v), np.asarray(got_v)
+        tot = np.asarray(ref_t)
+        assert np.array_equal(tot, np.asarray(got_t))
+        for v in range(V):
+            k = min(int(tot[v]), length)
+            assert np.array_equal(ref_v[v, :k], got_v[v, :k]), (V, S, v)
+
+
+def test_stack_push_pop_window_matches_gather(rng):
+    """Round-4 affine-window pushes: one dynamic slice of the padded plan
+    must equal the direct ``vacated[clip(n_in + (w - rel))]`` gather on
+    the in-use window entries."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.parallel import migrate
+
+    n, P = 96, 32
+    for trial in range(20):
+        free_stack = rng.permutation(n).astype(np.int32)
+        vacated = rng.integers(0, n, size=P).astype(np.int32)
+        n_free = int(rng.integers(0, n))
+        n_in = int(rng.integers(0, P // 2))
+        n_sent = int(rng.integers(n_in, P))
+        n_push = max(n_sent - n_in, 0)
+        n_pop = int(rng.integers(0, min(n_free, P - 1) + 1))
+        fs2, nf2 = migrate._stack_push_pop(
+            jnp.asarray(free_stack), jnp.int32(n_free), jnp.int32(n_pop),
+            jnp.int32(n_push), jnp.asarray(vacated), jnp.int32(n_in)
+        )
+        # reference semantics
+        fs_ref = free_stack.copy()
+        W = min(P, n)
+        win_start = int(np.clip(n_free, 0, max(n - W, 0)))
+        rel = n_free - win_start
+        for w in range(W):
+            if rel <= w < rel + n_push:
+                idx = int(np.clip(n_in + (w - rel), 0, P - 1))
+                if 0 <= win_start + w < n:
+                    fs_ref[win_start + w] = vacated[idx]
+        assert int(nf2) == n_free - n_pop + n_push
+        assert np.array_equal(np.asarray(fs2), fs_ref), trial
+
+
+def test_sorted_dest_counts_packed_fallback_boundary(rng):
+    """The packed one-word sort (round 4) and the 2-operand fallback must
+    agree bit-for-bit; force both paths across the bit-budget boundary."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.ops import binning
+
+    n = 4096  # b = 12 bits -> packed path needs n_dest + 1 <= 2^19
+    for n_dest in [7, 64, (1 << 19) - 1, 1 << 19]:
+        dest = rng.integers(0, n_dest + 1, size=n).astype(np.int32)
+        o, c, b = binning.sorted_dest_counts(jnp.asarray(dest), n_dest)
+        iota = np.arange(n)
+        ordr = np.lexsort((iota, dest))
+        ks = dest[ordr]
+        bounds = np.searchsorted(
+            ks, np.arange(n_dest + 1), side="left"
+        ).astype(np.int32)
+        assert np.array_equal(np.asarray(o), ordr), n_dest
+        assert np.array_equal(np.asarray(b), bounds), n_dest
