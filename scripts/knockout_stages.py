@@ -74,31 +74,64 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             return dep_out(flat)
 
         # ---- 1: bin (per-axis fused elementwise, matches migrate.py) ----
-        alive = flat[-1, :].reshape(V, n) > 0
-        dv = jnp.zeros((V * n,), jnp.int32)
-        for d in range(3):
-            p = migrate._pos_row(flat, d)
-            lo = jnp.asarray(domain.lo[d], p.dtype)
-            ext = jnp.asarray(domain.extent[d], p.dtype)
-            if domain.periodic[d]:
-                p = lo + binning.remainder_fast(p - lo, domain.extent[d])
-                p = jnp.where(p >= lo + ext, lo, p)
-            inv_w = jnp.asarray(vgrid.shape[d], p.dtype) / ext
-            cell_d = jnp.clip(
-                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
-                0,
-                vgrid.shape[d] - 1,
+        if os.environ.get("KNOCKOUT_BIN") == "flat":
+            # FLAT variant: no [V*n] <-> [V, n] reshapes until the sort
+            # boundary (each reshape relayouts 256 MB at the north-star);
+            # the per-column vrank id is a loop-invariant constant that
+            # XLA hoists out of the scan.
+            alive_f = flat[-1, :] > 0
+            dv = jnp.zeros((V * n,), jnp.int32)
+            for d in range(3):
+                p = migrate._pos_row(flat, d)
+                lo = jnp.asarray(domain.lo[d], p.dtype)
+                ext = jnp.asarray(domain.extent[d], p.dtype)
+                if domain.periodic[d]:
+                    p = lo + binning.remainder_fast(
+                        p - lo, domain.extent[d]
+                    )
+                    p = jnp.where(p >= lo + ext, lo, p)
+                inv_w = jnp.asarray(vgrid.shape[d], p.dtype) / ext
+                cell_d = jnp.clip(
+                    jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                    0,
+                    vgrid.shape[d] - 1,
+                )
+                dv = dv + cell_d * vgrid.strides[d]
+            col_v = jnp.repeat(my_v, n)  # loop-invariant, hoisted
+            dest_key = jnp.where(
+                alive_f & (dv != col_v), dv, R_total
+            ).astype(jnp.int32).reshape(V, n)
+            alive = alive_f.reshape(V, n)
+            if phase == 1:
+                return dep_out(dest_key)
+        else:
+            alive = flat[-1, :].reshape(V, n) > 0
+            dv = jnp.zeros((V * n,), jnp.int32)
+            for d in range(3):
+                p = migrate._pos_row(flat, d)
+                lo = jnp.asarray(domain.lo[d], p.dtype)
+                ext = jnp.asarray(domain.extent[d], p.dtype)
+                if domain.periodic[d]:
+                    p = lo + binning.remainder_fast(
+                        p - lo, domain.extent[d]
+                    )
+                    p = jnp.where(p >= lo + ext, lo, p)
+                inv_w = jnp.asarray(vgrid.shape[d], p.dtype) / ext
+                cell_d = jnp.clip(
+                    jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                    0,
+                    vgrid.shape[d] - 1,
+                )
+                # no mod: cell_d < shape[d] statically (int32 mod has no
+                # native VPU lowering — matches the Dev==1 engine elision)
+                dv = dv + cell_d * vgrid.strides[d]
+            dv = dv.reshape(V, n)
+            staying = dv == my_v[:, None]
+            dest_key = jnp.where(alive & ~staying, dv, R_total).astype(
+                jnp.int32
             )
-            # no mod: cell_d < shape[d] statically (int32 mod has no
-            # native VPU lowering — matches the Dev==1 engine elision)
-            dv = dv + cell_d * vgrid.strides[d]
-        dv = dv.reshape(V, n)
-        staying = dv == my_v[:, None]
-        dest_key = jnp.where(alive & ~staying, dv, R_total).astype(
-            jnp.int32
-        )
-        if phase == 1:
-            return dep_out(dest_key)
+            if phase == 1:
+                return dep_out(dest_key)
 
         # ---- 2: stable key sort + counts --------------------------------
         order, counts, bounds = jax.vmap(
@@ -356,10 +389,19 @@ def main():
                     vf = lax.bitcast_convert_type(f[3:6, :], jnp.float32)
                     p = pf + vf * jnp.float32(1e-4)
                     p = binning.wrap_periodic_planar(p, domain)
-                    f = jnp.concatenate(
-                        [lax.bitcast_convert_type(p, jnp.int32), f[3:, :]],
-                        axis=0,
-                    )
+                    if os.environ.get("KNOCKOUT_DRIFT") == "dus":
+                        f = lax.dynamic_update_slice(
+                            f, lax.bitcast_convert_type(p, jnp.int32),
+                            (0, 0),
+                        )
+                    else:
+                        f = jnp.concatenate(
+                            [
+                                lax.bitcast_convert_type(p, jnp.int32),
+                                f[3:, :],
+                            ],
+                            axis=0,
+                        )
                     st2 = step(st._replace(fused=f))
                     return st2, ()
 
