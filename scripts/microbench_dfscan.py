@@ -1,0 +1,68 @@
+"""On-chip: Pallas VMEM double-float tile prefix vs the XLA doubling loop.
+
+Shapes mirror the 64M north-star deposit's per-channel-group prefix:
+[g*T, 256] = [524288, 256] rows (cg=2 channel group). Bit-identity is
+asserted first; both paths then timed with the scan harness.
+
+Usage: python scripts/microbench_dfscan.py [rows] [tile]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.ops import deposit, pallas_dfscan
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 524288
+    tile = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    r = np.random.default_rng(0)
+    x = (r.random((rows, tile), dtype=np.float32)) * np.exp(
+        r.normal(0, 4, size=(rows, tile))
+    ).astype(np.float32)
+    xd = jax.device_put(jnp.asarray(x))
+
+    hi_k, lo_k = pallas_dfscan.tile_df_cumsum_rows(xd)
+    hi_x, lo_x = jax.jit(
+        lambda a: deposit._df_cumsum(a, axis=1)
+    )(xd)
+    for a, b, name in ((hi_k, hi_x, "hi"), (lo_k, lo_x, "lo")):
+        aa = np.asarray(a).view(np.uint32)
+        bb = np.asarray(b).view(np.uint32)
+        assert np.array_equal(aa, bb), (
+            f"{name} mismatch: {np.sum(aa != bb)} of {aa.size}"
+        )
+    print("bit-identity kernel vs XLA _df_cumsum: OK", flush=True)
+
+    def timed(name, fn):
+        def make_loop(S):
+            @jax.jit
+            def loop(a):
+                def body(acc, _):
+                    hi, lo = fn(acc)
+                    return hi + lo * jnp.float32(1e-30), ()
+
+                acc, _ = lax.scan(body, a, None, length=S)
+                return acc
+
+            return loop
+
+        per, _, _ = profiling.scan_time_per_step(
+            make_loop, (xd,), s1=2, s2=8
+        )
+        print(f"  {name}: {per*1e3:8.2f} ms", flush=True)
+
+    timed("pallas VMEM dfscan", pallas_dfscan.tile_df_cumsum_rows)
+    timed("XLA doubling loop", lambda a: deposit._df_cumsum(a, axis=1))
+
+
+if __name__ == "__main__":
+    main()

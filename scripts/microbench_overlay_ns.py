@@ -92,15 +92,23 @@ def main():
 
         timed(f"prep only (sort+planes+starts) W={w}", prep, fd, td, cd)
 
-    # 3: full drop-in, W swept
-    for w in (2048, 4096, 8192, 16384):
+    # 3: full drop-in, (W, rmax) swept
+    # rmax=64 does not lower (Mosaic: lane slices must be 128-aligned);
+    # rmax=256 measured WORSE at 64M (82.9 vs 73.1 ms at W=4096) — the
+    # default (4096, 128) stands
+    for w, rmax in (
+        (2048, 128), (4096, 128), (8192, 128),
+        (4096, 256), (8192, 256),
+    ):
         if m % w:
             continue
 
-        def full(t, c, f, w=w):
-            return pallas_overlay.overlay_scatter_planar(f, t, c, w=w)
+        def full(t, c, f, w=w, rmax=rmax):
+            return pallas_overlay.overlay_scatter_planar(
+                f, t, c, w=w, rmax=rmax
+            )
 
-        timed(f"overlay full W={w}", full, fd, td, cd)
+        timed(f"overlay full W={w} rmax={rmax}", full, fd, td, cd)
 
 
 if __name__ == "__main__":

@@ -480,3 +480,30 @@ def test_vrank_deposit_matches_flat(rng, _devices):
     expected = cic_numpy(pos[alive], np.ones(alive.sum(), np.float32),
                          dshape, domain)
     np.testing.assert_allclose(rho, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_dfscan_bit_identical_to_xla():
+    """The VMEM double-float prefix kernel must reproduce _df_cumsum
+    bit-for-bit — the scan deposit's accuracy contract rides on the
+    exact TwoSum sequence."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.ops import deposit, pallas_dfscan
+
+    r = np.random.default_rng(11)
+    for rows, tile in [(7, 256), (300, 128), (1025, 64)]:
+        x = (r.random((rows, tile), dtype=np.float32) - 0.5) * np.exp(
+            r.normal(0, 8, size=(rows, tile))
+        ).astype(np.float32)
+        hi_ref, lo_ref = deposit._df_cumsum(jnp.asarray(x), axis=1)
+        hi_k, lo_k = pallas_dfscan.tile_df_cumsum_rows(
+            jnp.asarray(x), interpret=True
+        )
+        assert np.array_equal(
+            np.asarray(hi_ref).view(np.uint32),
+            np.asarray(hi_k).view(np.uint32),
+        ), (rows, tile)
+        assert np.array_equal(
+            np.asarray(lo_ref).view(np.uint32),
+            np.asarray(lo_k).view(np.uint32),
+        ), (rows, tile)
