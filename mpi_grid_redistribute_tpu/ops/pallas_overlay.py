@@ -171,6 +171,10 @@ def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX):
             pltpu.VMEM((ROWS, w), jnp.float32),  # overlay accumulator
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        # the pre-landing state is dead once the kernel has streamed it:
+        # aliasing in->out lets XLA update the 1.8 GB (at 64M) state
+        # buffer in place instead of allocating + copying a fresh one
+        input_output_aliases={2: 0},
         interpret=interpret,
     )(starts, planes, flat)
 
