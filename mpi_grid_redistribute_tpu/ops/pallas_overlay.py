@@ -33,11 +33,13 @@ flush denormals to zero (measured); the bias keeps every pattern a
 normal float for any ``m < 2^30`` — and a ones row yields the hit mask.
 
 MEASURED (v5e-class chip, 8.4M-column planar state, 196k updates —
-scripts/microbench_overlay.py): XLA column scatter 17.4 ms; this kernel
-6.7 ms end-to-end including the XLA-side payload sort and plane prep
-(2.6x, W swept 512-8192). In the migrate step (bench.py headline) the
-landing phase drops from 27.5 ms to 12.1 ms in context and the step
-from 44.3 to 36.9 ms; see BENCH_CONFIGS.md.
+scripts/microbench_overlay.py): XLA column scatter 17.6 ms; this kernel
+3.93 ms end-to-end including the XLA-side payload sort and plane prep
+(4.4x — round 4: double-buffered chunk DMA, W=4096, in/out aliasing; the
+round-3 single-buffered form was 6.7 ms/2.6x). At the 64M north-star:
+73.1 vs 132.6 ms. In the migrate step the landing phase drove the
+headline from 44.3 (round 2, XLA scatter) to 24.8 ms; see
+BENCH_CONFIGS.md.
 
 Contract: ``flat`` f32 planar ``[K, m]`` with ``2 * K + 2 <= ROWS``
 (i.e. K <= 7 at ROWS = 16: pos 3 + vel 3 + alive), ``m`` a multiple of
