@@ -51,6 +51,7 @@ Contract: ``flat`` f32 planar ``[K, m]`` with ``2 * K + 2 <= ROWS``
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -181,8 +182,21 @@ def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX):
     )(starts, planes, flat)
 
 
+def _raise_on_duplicate_targets(dup) -> None:
+    dup = int(dup)
+    if dup > 0:
+        raise ValueError(
+            f"overlay_scatter_planar: {dup} duplicate in-range target(s). "
+            "The one-hot kernel would accumulate both contributions into "
+            "the half-planes and emit garbage words silently (the XLA "
+            "scatter merely picks one writer). Every in-range target must "
+            "be unique — see parallel/migrate._land_scatter's docstring "
+            "for where the engines establish this invariant."
+        )
+
+
 def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
-                           rmax=RMAX):
+                           rmax=RMAX, debug_unique=None):
     """Drop-in for ``flat.at[:, targets].set(cols, mode='drop')``.
 
     ``flat`` f32 or int32 ``[K, m]`` (int32 is the migrate engines' round-4
@@ -191,9 +205,38 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     ``targets`` int32 ``[P]`` unique among in-range entries (>= m drops);
     ``cols`` ``[K, P]`` matching ``flat``. Falls back to the XLA scatter
     when the kernel contract doesn't hold (see module docstring).
+
+    ``debug_unique`` (default: env ``MPI_GRID_OVERLAY_DEBUG=1``, read at
+    trace time) verifies the uniqueness contract: a duplicate in-range
+    target raises instead of silently corrupting state. Concrete inputs
+    are checked eagerly on the host; traced inputs go through
+    ``jax.debug.callback``, which the experimental axon TPU platform does
+    not support — the flag is meant for CPU/interpret validation runs of
+    new callers, not production steps.
     """
     k, m = flat.shape
     p = targets.shape[0]
+    if debug_unique is None:
+        debug_unique = os.environ.get("MPI_GRID_OVERLAY_DEBUG") == "1"
+    if debug_unique and p > 1:
+        # BEFORE the contract fallback: uniqueness is a property of the
+        # targets, not the shapes — a validation run at a fallback-
+        # triggering size must still catch a caller whose duplicates
+        # would corrupt state once production shapes hit the kernel path.
+        t32 = targets.astype(jnp.int32)
+        tsd = jnp.sort(jnp.where((t32 < 0) | (t32 >= m), jnp.int32(m), t32))
+        dup = jnp.sum(
+            ((tsd[1:] == tsd[:-1]) & (tsd[1:] < m)).astype(jnp.int32)
+        )
+        try:
+            dup_val = int(dup)  # concrete: host-side check, axon-safe
+        except (
+            jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError,
+        ):
+            jax.debug.callback(_raise_on_duplicate_targets, dup)
+        else:
+            _raise_on_duplicate_targets(dup_val)
     if w is None:
         # with the double-buffered chunk DMA, W=4096 wins at every
         # measured size: 3.93 ms vs 7.45 at 2048 on the 8.4M headline

@@ -90,6 +90,52 @@ def test_overlay_dense_updates_multichunk(rng, _devices):
     )
 
 
+def test_overlay_debug_unique_check(rng, _devices):
+    """debug_unique raises on duplicate in-range targets (the silent-
+    corruption case the round-3 advisor flagged) and passes clean calls
+    — duplicate SENTINELS (dropped entries) stay legal."""
+    r = np.random.default_rng(11)
+    k, m = 7, 2 * 256
+    w, rmax = 256, 128
+    flat = r.standard_normal((k, m)).astype(np.float32)
+    cols = r.standard_normal((k, 4)).astype(np.float32)
+    dup_targets = np.array([3, 17, 17, 200], np.int32)
+    with pytest.raises(ValueError, match="duplicate in-range"):
+        pallas_overlay.overlay_scatter_planar(
+            jnp.asarray(flat), jnp.asarray(dup_targets), jnp.asarray(cols),
+            interpret=True, w=w, rmax=rmax, debug_unique=True,
+        )
+    # unique in-range + repeated drop sentinels: fine, and bit-correct
+    ok_targets = np.array([3, 17, m, m], np.int32)
+    out = pallas_overlay.overlay_scatter_planar(
+        jnp.asarray(flat), jnp.asarray(ok_targets), jnp.asarray(cols),
+        interpret=True, w=w, rmax=rmax, debug_unique=True,
+    )
+    want = _ref(flat, np.array([3, 17], np.int32), cols[:, :2])
+    np.testing.assert_array_equal(
+        np.asarray(out).view(np.uint32), want.view(np.uint32)
+    )
+    # fallback-triggering shape (m not a multiple of w): the check must
+    # STILL fire — uniqueness is a property of the targets, not shapes
+    flat_odd = r.standard_normal((k, 100)).astype(np.float32)
+    with pytest.raises(ValueError, match="duplicate in-range"):
+        pallas_overlay.overlay_scatter_planar(
+            jnp.asarray(flat_odd), jnp.asarray(dup_targets),
+            jnp.asarray(cols), interpret=True, w=w, rmax=rmax,
+            debug_unique=True,
+        )
+    # traced path: the check rides jax.debug.callback
+    f = jax.jit(
+        lambda fl, t, c: pallas_overlay.overlay_scatter_planar(
+            fl, t, c, interpret=True, w=w, rmax=rmax, debug_unique=True
+        )
+    )
+    with pytest.raises(Exception, match="duplicate in-range"):
+        jax.block_until_ready(
+            f(jnp.asarray(flat), jnp.asarray(dup_targets), jnp.asarray(cols))
+        )
+
+
 def test_overlay_fallback_on_contract_violation(rng, _devices):
     r = np.random.default_rng(4)
     # m not a multiple of w -> falls back to XLA scatter (still correct)
