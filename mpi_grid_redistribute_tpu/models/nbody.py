@@ -15,6 +15,7 @@ equals the input padding, making the step state a fixed-shape carry.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 from typing import Optional, Tuple
 
@@ -26,7 +27,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
-from mpi_grid_redistribute_tpu.ops import binning, deposit as deposit_lib
+from mpi_grid_redistribute_tpu.ops import (
+    binning,
+    deposit as deposit_lib,
+    pallas_driftbin,
+)
 from mpi_grid_redistribute_tpu.parallel import exchange, migrate, mesh as mesh_lib
 
 
@@ -273,6 +278,21 @@ def make_migrate_loop(
             local_budget=cfg.local_budget,
             cells=cfg.cells, assignment=cfg.assignment,
         )
+    # Fused Pallas drift+wrap+bin (round 4): one streaming pass replaces
+    # the XLA drift chain AND the engine's binning (the knockout's 9x-
+    # over-roofline phase 0-1). Resolved at BUILD time like the landing
+    # scatter impl: MPI_GRID_DRIFTBIN=xla opts out; the kernel itself
+    # falls back to its bit-identical XLA twin when the shape/domain
+    # contract doesn't hold (ops/pallas_driftbin.py).
+    use_driftbin = (
+        os.environ.get("MPI_GRID_DRIFTBIN") != "xla"
+        and jax.devices()[0].platform in ("tpu", "axon")
+        and vgrid is not None
+        and cfg.grid.nranks == 1
+        and cfg.assignment is None
+    )
+    full_grid = vgrid  # Dev == 1: the full Cartesian grid IS vgrid
+
     dep_fn = None
     if cfg.deposit_shape is not None:
         if cfg.deposit_method == "scan":
@@ -355,14 +375,28 @@ def make_migrate_loop(
         def body(carry, _):
             state = carry[0]
             f = state.fused  # planar int32 [K, m]
-            pf = lax.bitcast_convert_type(f[:D, :], jnp.float32)
-            vf = lax.bitcast_convert_type(f[D : 2 * D, :], jnp.float32)
-            p = pf + vf * jnp.asarray(cfg.dt, pf.dtype)
-            p = binning.wrap_periodic_planar(p, cfg.domain)
-            f = jnp.concatenate(
-                [lax.bitcast_convert_type(p, jnp.int32), f[D:, :]], axis=0
-            )
-            state, stats = mig(state._replace(fused=f))
+            if use_driftbin:
+                # ONE streaming Pallas pass: drift + wrap + bin + dest
+                # key (ops/pallas_driftbin.py; bit-identical to the XLA
+                # chain below by test; 6-7x its measured cost — the XLA
+                # chain runs ~9x its bandwidth roofline)
+                f, dest_key = pallas_driftbin.drift_wrap_bin(
+                    f, float(cfg.dt), cfg.domain, full_grid,
+                    V, V,
+                )
+                state, stats = mig(state._replace(fused=f), dest_key)
+            else:
+                pf = lax.bitcast_convert_type(f[:D, :], jnp.float32)
+                vf = lax.bitcast_convert_type(
+                    f[D : 2 * D, :], jnp.float32
+                )
+                p = pf + vf * jnp.asarray(cfg.dt, pf.dtype)
+                p = binning.wrap_periodic_planar(p, cfg.domain)
+                f = jnp.concatenate(
+                    [lax.bitcast_convert_type(p, jnp.int32), f[D:, :]],
+                    axis=0,
+                )
+                state, stats = mig(state._replace(fused=f))
             new_carry = (state,)
             if deposit_each_step:
                 new_carry = (state, _deposit(state.fused))

@@ -907,7 +907,7 @@ def shard_migrate_vranks_fn(
         )
     scatter_impl = _resolve_scatter_impl(scatter_impl)
 
-    def fn(state: MigrateState):
+    def fn(state: MigrateState, dest_key=None):
         flat, free_stack, n_free = state  # [K, V*n], [V, n], [V]
         K = flat.shape[0]
         n = flat.shape[1] // V
@@ -917,56 +917,68 @@ def shard_migrate_vranks_fn(
         # ---- binning: per-axis fused elementwise chains (no stacked
         # [D, m] intermediates — each axis's wrap+floor+clip+accumulate
         # fuses into one pass over [V*n]; the stacked helper variant
-        # measured 22x its bandwidth roofline in the knockout profile)
-        alive = flat[-1, :].reshape(V, n) > 0
-        dest_dev = jnp.zeros((V * n,), jnp.int32)
-        dest_v = jnp.zeros((V * n,), jnp.int32)
-        for d in range(D):
-            p = _pos_row(flat, d)
-            lo = jnp.asarray(domain.lo[d], p.dtype)
-            ext = jnp.asarray(domain.extent[d], p.dtype)
-            if domain.periodic[d]:
-                # reciprocal-multiply wrap (see shard_migrate_fused_fn)
-                p = lo + binning.remainder_fast(p - lo, domain.extent[d])
-                p = jnp.where(p >= lo + ext, lo, p)
-            inv_w = jnp.asarray(full_grid.shape[d], p.dtype) / ext
-            cell_d = jnp.clip(
-                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
-                0,
-                full_grid.shape[d] - 1,
-            )
-            if assignment is not None:
-                # accumulate the full row-major cell id; ownership comes
-                # from the static assignment table below
-                dest_v = dest_v + cell_d * jnp.int32(full_grid.strides[d])
-            else:
-                vs = vgrid.shape[d]
-                if dev_grid.shape[d] == 1:
-                    # single device slab on this axis: cell_d < vs
-                    # statically, so the // and % are identities — int32
-                    # div/mod have no native VPU lowering and cost real
-                    # passes over [V*n] (round-4 phase-1 attribution)
-                    dest_v = dest_v + cell_d * vgrid.strides[d]
-                else:
-                    dest_dev = (
-                        dest_dev + (cell_d // vs) * dev_grid.strides[d]
+        # measured 22x its bandwidth roofline in the knockout profile).
+        # A caller may pass a precomputed ``dest_key`` [V, n] instead
+        # (device-major global rank, sentinel R_total for holes/stayers)
+        # — the fused Pallas drift+wrap+bin kernel emits it in the same
+        # streaming pass as the drift (ops/pallas_driftbin.py,
+        # bit-identical to this chain by test).
+        if dest_key is None:
+            alive = flat[-1, :].reshape(V, n) > 0
+            dest_dev = jnp.zeros((V * n,), jnp.int32)
+            dest_v = jnp.zeros((V * n,), jnp.int32)
+            for d in range(D):
+                p = _pos_row(flat, d)
+                lo = jnp.asarray(domain.lo[d], p.dtype)
+                ext = jnp.asarray(domain.extent[d], p.dtype)
+                if domain.periodic[d]:
+                    # reciprocal-multiply wrap (see shard_migrate_fused_fn)
+                    p = lo + binning.remainder_fast(
+                        p - lo, domain.extent[d]
                     )
-                    dest_v = dest_v + (cell_d % vs) * vgrid.strides[d]
-        if assignment is not None:
-            # one gather from the tiny [n_cells] table: cell -> global rank
-            g = jnp.take(
-                jnp.asarray(assignment, jnp.int32), dest_v, axis=0
-            )
-            dest_dev = g // V
-            dest_v = g - dest_dev * V
-        dest_dev = dest_dev.reshape(V, n)
-        dest_v = dest_v.reshape(V, n)
-        staying = (dest_dev == me_dev) & (dest_v == my_v[:, None])
-        leaving = alive & ~staying
-        # device-major global destination: dev * V + vrank
-        dest_key = jnp.where(
-            leaving, dest_dev * V + dest_v, R_total
-        ).astype(jnp.int32)  # [V, n]
+                    p = jnp.where(p >= lo + ext, lo, p)
+                inv_w = jnp.asarray(full_grid.shape[d], p.dtype) / ext
+                cell_d = jnp.clip(
+                    jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                    0,
+                    full_grid.shape[d] - 1,
+                )
+                if assignment is not None:
+                    # accumulate the full row-major cell id; ownership
+                    # comes from the static assignment table below
+                    dest_v = dest_v + cell_d * jnp.int32(
+                        full_grid.strides[d]
+                    )
+                else:
+                    vs = vgrid.shape[d]
+                    if dev_grid.shape[d] == 1:
+                        # single device slab on this axis: cell_d < vs
+                        # statically, so the // and % are identities —
+                        # int32 div/mod have no native VPU lowering and
+                        # cost real passes over [V*n] (round-4 phase-1
+                        # attribution)
+                        dest_v = dest_v + cell_d * vgrid.strides[d]
+                    else:
+                        dest_dev = (
+                            dest_dev + (cell_d // vs) * dev_grid.strides[d]
+                        )
+                        dest_v = dest_v + (cell_d % vs) * vgrid.strides[d]
+            if assignment is not None:
+                # one gather from the tiny [n_cells] table: cell ->
+                # global rank
+                g = jnp.take(
+                    jnp.asarray(assignment, jnp.int32), dest_v, axis=0
+                )
+                dest_dev = g // V
+                dest_v = g - dest_dev * V
+            dest_dev = dest_dev.reshape(V, n)
+            dest_v = dest_v.reshape(V, n)
+            staying = (dest_dev == me_dev) & (dest_v == my_v[:, None])
+            leaving = alive & ~staying
+            # device-major global destination: dev * V + vrank
+            dest_key = jnp.where(
+                leaving, dest_dev * V + dest_v, R_total
+            ).astype(jnp.int32)  # [V, n]
 
         # NOTE a flat composite-key sort (one [V*n] sort replacing the V
         # vmapped sorts) was measured and REJECTED: the vmapped
