@@ -267,11 +267,21 @@ def make_migrate_loop(
             cfg.domain, cfg.grid, cfg.capacity
         )
     else:
-        if cfg.assignment is not None and cfg.deposit_shape is not None:
+        if (
+            cfg.assignment is not None
+            and cfg.deposit_shape is not None
+            and not (cfg.deposit_method == "scan" and mesh.size == 1)
+        ):
+            # the DEVICE-keyed planar deposit doesn't care which vrank a
+            # particle rides in — it keys by position — so on one device
+            # (which owns the whole contiguous mesh) LPT assignment and
+            # deposit compose; multi-device LPT leaves each device a
+            # non-contiguous cell set, which no block deposit can serve
             raise ValueError(
                 "assignment-decomposed vranks own non-contiguous cell "
-                "sets; the per-vrank block deposit assumes spatial "
-                "slabs — deposit on the canonical layout instead"
+                "sets; the block deposit assumes each device owns a "
+                "contiguous region — deposit on the canonical layout, "
+                "or use deposit_method='scan' on a single device"
             )
         mig = migrate.shard_migrate_vranks_fn(
             cfg.domain, cfg.grid, vgrid, cfg.capacity,
@@ -300,12 +310,12 @@ def make_migrate_loop(
             # rows directly — no in-loop [n, 3] transpose (a [64M, 3]
             # transient is a 32 GB T(8,128) allocation; round-3 verdict
             # item 3), so config 5 runs at the 64M north-star shape.
-            dep_fn = deposit_lib.shard_deposit_vranks_planar_fn(
-                cfg.domain, cfg.grid,
-                vgrid if vgrid is not None else ProcessGrid(
-                    (1,) * cfg.domain.ndim
-                ),
-                cfg.deposit_shape,
+            # DEVICE-keyed (late round 4): segments are device-local
+            # global cells, so the per-vrank ghost-block assembly (64
+            # sequential dynamic-slice adds, ~54 ms of the 4.2M deposit —
+            # scripts/knockout_deposit.py) vanishes into the segment sums.
+            dep_fn = deposit_lib.shard_deposit_device_planar_fn(
+                cfg.domain, cfg.grid, cfg.deposit_shape,
             )
         elif vgrid is None:
             dep_fn, _ = deposit_lib.shard_deposit_fn_masked(

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning
 
 
 def _check_mesh_shape(
@@ -288,14 +289,13 @@ def _sorted_per_segment(
     s_hi = jnp.concatenate([z8, thi], axis=0)  # exclusive tile prefixes
     s_lo = jnp.concatenate([z8, tlo], axis=0)  # [T + 1, 8]
 
-    # method="sort" lowers to one merge-style sort; the default "scan"
-    # becomes a sequential while-loop (~80 ms at 262k queries, measured)
-    bounds = jnp.searchsorted(
-        keys_sorted,
-        jnp.arange(n_segments + 1, dtype=jnp.int32),
-        side="left",
-        method="sort",
-    ).astype(jnp.int32)
+    # scatter-free dense searchsorted (binning.bounds_dense): the
+    # jnp method="sort" ranks via a full-length scatter — 1140 ms at the
+    # 64M north-star (scripts/knockout_deposit.py), the largest single
+    # phase of fused config 5; the 2-sort form is exact-int identical
+    bounds = binning.bounds_dense(
+        keys_sorted, n_segments + 1, key_bound=n_segments
+    )
     # paired prefix G(b) = sum of first b sorted rows, evaluated only at
     # the run boundaries: tile part + within-tile part (zero when b lands
     # exactly on a tile edge). The (hi, lo) pairs ride ONE gather each as
@@ -387,12 +387,9 @@ def _sorted_per_segment_planar(
     nch = len(corners)
     K = max(1, min(tile, n))
     n_pad = -(-n // K) * K
-    bounds = jnp.searchsorted(
-        keys_sorted,
-        jnp.arange(n_segments + 1, dtype=jnp.int32),
-        side="left",
-        method="sort",
-    ).astype(jnp.int32)
+    bounds = binning.bounds_dense(
+        keys_sorted, n_segments + 1, key_bound=n_segments
+    )
     t_idx = bounds // K
     has_local = (bounds % K > 0)[None, :]
     lb = jnp.clip(bounds - 1, 0, n_pad - 1)
@@ -520,6 +517,92 @@ def cic_deposit_vranks_planar(
         ]
         total = total + jnp.pad(per_cell[k], pad)
     return total
+
+
+def cic_deposit_device_planar(
+    pos_rows: jax.Array,
+    mass: jax.Array,
+    valid: jax.Array,
+    dev_lo: jax.Array,
+    inv_h: jax.Array,
+    dev_block: Tuple[int, ...],
+    tile: int = 256,
+) -> jax.Array:
+    """PLANAR scan deposit keyed by DEVICE-local cell (no vrank structure).
+
+    The vrank deposit (:func:`cic_deposit_vranks_planar`) keys particles by
+    ``(vrank, cell-within-vrank)`` and then assembles V +1-ghost blocks onto
+    the device mesh with 64 sequential dynamic-slice adds — measured at
+    ~54 ms of the 4.2M-row deposit (scripts/knockout_deposit.py) for work
+    that is pure bookkeeping. This variant keys by the device-local global
+    cell directly: identical segment COUNT (``prod(dev_block)``), identical
+    particle grouping, one slab — the assembly disappears into the segment
+    sums themselves (a vrank-face corner contribution lands in its true
+    cell's segment instead of riding a ghost-plane add afterwards; the
+    summation ORDER therefore differs from the vrank path by design, while
+    staying bit-identical to the row-major device twin
+    :func:`cic_deposit_local_sorted` on the same inputs — tested).
+
+    ``pos_rows [D, n]`` component-major, ``mass``/``valid`` ``[n]``,
+    ``dev_lo [D]`` the device block origin. Returns the +1-ghost device
+    mesh ``[*(dev_block + 1)]``.
+
+    Implementation: the vranks planar core at ``V = 1`` IS device-cell
+    keying (``key = 0 * n_cells + cell``), so this delegates rather than
+    duplicating the rel/key/prefix pipeline (review round 4).
+    """
+    return cic_deposit_vranks_planar(
+        pos_rows, mass, valid, dev_lo[None, :], inv_h, dev_block,
+        tile=tile,
+    )[0]
+
+
+def shard_deposit_device_planar_fn(
+    domain: Domain,
+    dev_grid: ProcessGrid,
+    mesh_shape: Tuple[int, ...],
+):
+    """Per-device planar CIC deposit keyed by device-local cells.
+
+    The planar deposit the fused migrate loop uses (see
+    :func:`cic_deposit_device_planar` for why this supersedes the
+    per-vrank assembly): signature ``(pos_rows [D, m], mass [m],
+    valid [m]) -> rho_local``. vrank slab structure in ``pos_rows`` is
+    irrelevant — the deposit keys by position, so it also works for
+    assignment-decomposed (LPT) vranks whenever the DEVICE's cells form a
+    contiguous block (always true on one device owning the whole mesh).
+    """
+    _check_mesh_shape(domain, dev_grid, mesh_shape)
+    ndim = domain.ndim
+    dev_block = tuple(
+        m // g for m, g in zip(mesh_shape, dev_grid.shape)
+    )
+    inv_h = jnp.asarray(
+        [m / e for m, e in zip(mesh_shape, domain.extent)], jnp.float32
+    )
+    widths = dev_grid.cell_widths(domain)
+
+    def fn(pos_rows, mass, valid):
+        me_cell = [
+            lax.axis_index(name).astype(jnp.int32)
+            for name in dev_grid.axis_names
+        ]
+        dev_lo = jnp.stack(
+            [
+                jnp.asarray(domain.lo[a], jnp.float32)
+                + me_cell[a].astype(jnp.float32)
+                * jnp.asarray(widths[a], jnp.float32)
+                for a in range(ndim)
+            ]
+        )
+        rho = cic_deposit_device_planar(
+            pos_rows, mass, valid, dev_lo, inv_h, dev_block
+        )
+        if all(domain.periodic):
+            return fold_ghosts(rho, dev_grid)
+        return assemble_dense(rho, dev_grid, domain)
+
+    return fn
 
 
 def cic_deposit_vranks_sorted(
@@ -818,6 +901,13 @@ def shard_deposit_vranks_planar_fn(
     mesh_shape: Tuple[int, ...],
 ):
     """PLANAR per-device CIC deposit consuming component-major rows.
+
+    RETAINED BASELINE (late round 4): the production fused loop now uses
+    :func:`shard_deposit_device_planar_fn` — device-cell keys make the
+    per-vrank ghost assembly below (V dynamic-slice adds, measured
+    +54 ms at 4.2M rows / +198 ms at 64M, scripts/knockout_deposit.py)
+    unnecessary. This wrapper is kept as the measured comparison point
+    and vrank-grouped reference; it has no production callers.
 
     The planar twin of :func:`shard_deposit_vranks_fn` (scan method):
     signature ``(pos_rows [D, V * n], mass [V * n], valid [V * n]) ->

@@ -58,6 +58,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi_grid_redistribute_tpu.ops import binning
+
 W = 2048  # baseline lane-block width; `overlay_scatter_planar` upgrades
 #          to 4096 whenever m divides (round-4 on-chip sweep with the
 #          double-buffered chunk DMA: 3.93 ms at 4096 vs 7.45 at 2048 on
@@ -74,8 +76,13 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
     start = starts_ref[b]
     end = starts_ref[b + 1]
     acc[:] = jnp.zeros_like(acc)
-    c0 = start // rmax
-    c1 = (end + rmax - 1) // rmax
+    # lax.div, not `//`: jnp floor_divide traces `sign(divisor)` on the
+    # constant, and mixing that axis-invariant traced value with the
+    # (device-varying, under shard_map) `start` makes tracing insert a
+    # `pvary` inside the kernel jaxpr — which Mosaic cannot lower. Both
+    # operands are nonnegative, so truncating div IS floor div here.
+    c0 = jax.lax.div(start, jnp.int32(rmax))
+    c1 = jax.lax.div(end + jnp.int32(rmax - 1), jnp.int32(rmax))
 
     # DOUBLE-BUFFERED chunk DMA: the per-chunk start();wait() pair put a
     # full HBM round-trip latency on every chunk's critical path — at the
@@ -292,10 +299,18 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
         ],
         axis=0,
     )
-    edges = jnp.arange(0, m + w, w, dtype=jnp.int32)
-    starts = jnp.searchsorted(
-        ts, edges, side="left", method="sort"
-    ).astype(jnp.int32)
+    # scatter-free dense searchsorted (m < 2^30 is already guarded, so
+    # the ×2 code fits int32); jnp's method="sort" pays a P-length rank
+    # scatter — measured as a visible slice of the in-context landing
+    starts = binning.bounds_dense(
+        ts, m // w + 1, stride=w, key_bound=m
+    )
+    # under shard_map every pallas_call input must carry the same varying
+    # mesh axes or tracing inserts a `pvary` INSIDE the kernel jaxpr,
+    # which the Mosaic TPU lowering rejects; promote the scalar-prep
+    # arrays to the state's vma explicitly
+    starts = binning.match_vma(starts, flat)
+    planes = binning.match_vma(planes, flat)
     return _overlay_sorted(
         flat, starts, planes, interpret=interpret, w=w, rmax=rmax
     )

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi_grid_redistribute_tpu.ops import binning
+
 
 # VMEM budget: (BLOCK, K) f32 blocks lane-pad K -> 128, so an 8192-row
 # block occupies 4.2 MB; x2 double-buffer x (in + out) ~ 17 MB, over the
@@ -97,8 +99,10 @@ def _kernel(starts_ref, rows_t_hbm, tgt_t_hbm, in_ref, out_ref,
         jax.lax.fori_loop(i_lo, i_hi, row_body, None)
         return _
 
-    c0 = start // RMAX
-    c1 = (end + RMAX - 1) // RMAX
+    # lax.div, not `//` — see ops/pallas_overlay.py: jnp floor_divide's
+    # sign(const) trace forces an unlowerable `pvary` under shard_map
+    c0 = jax.lax.div(start, jnp.int32(RMAX))
+    c1 = jax.lax.div(end + jnp.int32(RMAX - 1), jnp.int32(RMAX))
     jax.lax.fori_loop(c0, c1, chunk_body, None)
 
 
@@ -148,9 +152,11 @@ def scatter_rows(flat, targets, rows, interpret=False):
     if n_rows % BLOCK or k > 8 or flat.dtype != jnp.float32:
         return flat.at[targets].set(rows, mode="drop")
     sentinel = jnp.int32(n_rows)
-    targets = jnp.where(targets >= n_rows, sentinel, targets).astype(
-        jnp.int32
-    )
+    # negatives are drops too; folding them into the sentinel keeps every
+    # sort key in [0, n_rows] (bounds_dense's ×2 encoding needs that)
+    targets = jnp.where(
+        (targets >= n_rows) | (targets < 0), sentinel, targets
+    ).astype(jnp.int32)
     ts, order = jax.lax.sort(
         (targets, jnp.arange(p, dtype=jnp.int32)), num_keys=1,
         is_stable=False,
@@ -166,8 +172,12 @@ def scatter_rows(flat, targets, rows, interpret=False):
     # transposed, 8-row-padded layouts for lane-aligned chunk DMAs
     rows_t = jnp.zeros((8, p_pad), rows.dtype).at[:k].set(rows_sorted.T)
     tgt_t = jnp.zeros((8, p_pad), jnp.int32).at[0].set(ts)
-    edges = jnp.arange(0, n_rows + BLOCK, BLOCK, dtype=jnp.int32)
-    starts = jnp.searchsorted(ts, edges, side="left", method="sort").astype(
-        jnp.int32
+    starts = binning.match_vma(
+        binning.bounds_dense(
+            ts, n_rows // BLOCK + 1, stride=BLOCK, key_bound=n_rows
+        ),
+        flat,
     )
+    rows_t = binning.match_vma(rows_t, flat)
+    tgt_t = binning.match_vma(tgt_t, flat)
     return _scatter_sorted(flat, starts, rows_t, tgt_t, interpret=interpret)

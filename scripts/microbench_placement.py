@@ -1,0 +1,126 @@
+"""Microbench: corner-channel placement of the device-keyed scan deposit.
+
+The deposit's final phase places 8 corner-channel meshes ``[8, M^3]`` onto
+the +1-ghost device mesh ``[(M+1)^3]`` and (fully-periodic, Dev=1) folds
+the ghost faces back. knockout_deposit measured this at +150 ms for
+M=128 — ~500x its ~0.3 ms roofline — because every ``jnp.pad`` that adds
+a LOW-side plane on the minor (lane) axis shifts the whole array by one
+lane (unaligned relayout), and the naive form does 8 of them.
+
+Variants:
+  A. naive: 8x pad to [(M+1)^3] + add, then self-fold      (the engine's
+     original form)
+  B. grouped: sum the 4 channels sharing each minor-axis offset FIRST on
+     [M+1, M+1, M] (high-axis pads only — aligned), then 2 minor-axis
+     pads + add + self-fold
+  C. rolls: fully-periodic Dev=1 skips the ghost entirely —
+     ``total = sum_k roll(block_k, corner_k)`` on [M^3]
+     (mathematically equal to fold(pads); different f32 add order)
+
+Usage: python scripts/microbench_placement.py [M]
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mpi_grid_redistribute_tpu.utils import profiling
+
+
+def variant_a(per_cell, M):
+    ghost = (M + 1,) * 3
+    total = jnp.zeros(ghost, jnp.float32)
+    for k, corner in enumerate(itertools.product((0, 1), repeat=3)):
+        pad = [(c, 1 - c) for c in corner]
+        total = total + jnp.pad(per_cell[k].reshape(M, M, M), pad)
+    return _self_fold(total)
+
+
+def variant_b(per_cell, M):
+    blocks = [per_cell[k].reshape(M, M, M) for k in range(8)]
+    groups = []
+    for c2 in (0, 1):
+        s = jnp.zeros((M + 1, M + 1, M), jnp.float32)
+        for k, corner in enumerate(itertools.product((0, 1), repeat=3)):
+            if corner[2] != c2:
+                continue
+            s = s + jnp.pad(
+                blocks[k], [(corner[0], 1 - corner[0]),
+                            (corner[1], 1 - corner[1]), (0, 0)]
+            )
+        groups.append(s)
+    total = jnp.pad(groups[0], [(0, 0), (0, 0), (0, 1)]) + jnp.pad(
+        groups[1], [(0, 0), (0, 0), (1, 0)]
+    )
+    return _self_fold(total)
+
+
+def variant_c(per_cell, M):
+    total = jnp.zeros((M, M, M), jnp.float32)
+    for k, corner in enumerate(itertools.product((0, 1), repeat=3)):
+        total = total + jnp.roll(
+            per_cell[k].reshape(M, M, M), corner, axis=(0, 1, 2)
+        )
+    return total
+
+
+def _self_fold(rho):
+    for a in range(3):
+        mm = rho.shape[a] - 1
+        ghost = jax.lax.slice_in_dim(rho, mm, mm + 1, axis=a)
+        body = jax.lax.slice_in_dim(rho, 0, mm, axis=a)
+        first = jax.lax.slice_in_dim(body, 0, 1, axis=a) + ghost
+        rho = jnp.concatenate(
+            [first, jax.lax.slice_in_dim(body, 1, mm, axis=a)], axis=a
+        )
+    return rho
+
+
+def main():
+    M = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    rng = np.random.default_rng(0)
+    per_cell = jax.device_put(
+        jnp.asarray(rng.random((8, M * M * M), np.float32))
+    )
+    ref = None
+    for name, fn in (("A naive-pads", variant_a),
+                     ("B grouped-pads", variant_b),
+                     ("C rolls", variant_c)):
+        def make_loop(S, fn=fn):
+            @jax.jit
+            def loop(x):
+                def body(c, _):
+                    out = fn(c, M)
+                    # fold a data dependency back into the carry
+                    return c.at[0, 0].add(out[0, 0, 0] * 1e-20), None
+
+                c, _ = jax.lax.scan(body, x, None, length=S)
+                return c
+
+            return loop
+
+        per, _, _ = profiling.scan_time_per_step(
+            make_loop, (per_cell,), s1=4, s2=16
+        )
+        out = np.asarray(jax.jit(fn, static_argnums=1)(per_cell, M))
+        tot = out.sum()
+        if ref is None:
+            ref = tot
+        print(
+            f"{name}: {per*1e3:8.3f} ms   sum={tot:.6e} "
+            f"(rel dev {abs(tot-ref)/abs(ref):.2e})",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

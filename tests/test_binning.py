@@ -164,3 +164,49 @@ def test_wrap_periodic_mixed_nonpow2_nonperiodic_axis():
     # periodic axes wrapped into range
     assert (a[:, 0] >= 0).all() and (a[:, 0] < 1.0).all()
     assert (a[:, 2] >= 0).all() and (a[:, 2] < 2.0).all()
+
+
+def test_bounds_dense_matches_searchsorted():
+    """The scatter-free dense searchsorted (two single-operand sorts) is
+    exact-int identical to jnp.searchsorted across segment shapes: empty
+    segments, duplicate runs, sentinel tails, strided edges, empty keys."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.ops import binning
+
+    rng = np.random.default_rng(5)
+    cases = []
+    for n, s in [(10_000, 257), (4096, 1), (5000, 4096), (1, 7), (513, 16)]:
+        keys = np.sort(rng.integers(0, s, size=n)).astype(np.int32)
+        cases.append((keys, s, 1, s))
+    # sentinel tail (invalid rows keyed past every edge)
+    keys = np.sort(
+        np.concatenate([rng.integers(0, 100, 900), np.full(100, 100)])
+    ).astype(np.int32)
+    cases.append((keys, 101, 1, 100))
+    # all-sentinel
+    cases.append((np.full(64, 50, np.int32), 51, 1, 50))
+    # strided edges (the pallas starts pattern)
+    keys = np.sort(rng.integers(0, 8192, size=20_000)).astype(np.int32)
+    cases.append((keys, 8192 // 512 + 1, 512, 8192))
+    for keys, n_edges, stride, key_bound in cases:
+        got = np.asarray(
+            binning.bounds_dense(
+                jnp.asarray(keys), n_edges, stride=stride,
+                key_bound=key_bound,
+            )
+        )
+        want = np.searchsorted(
+            keys, np.arange(n_edges, dtype=np.int64) * stride, side="left"
+        ).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+    # int32-overflow guard falls back to jnp.searchsorted, still exact
+    keys = np.sort(rng.integers(0, 2**30, size=1000)).astype(np.int32)
+    got = np.asarray(
+        binning.bounds_dense(
+            jnp.asarray(keys), 100, stride=2**24, key_bound=2**30
+        )
+    )
+    want = np.searchsorted(
+        keys, np.arange(100, dtype=np.int64) * 2**24, side="left"
+    ).astype(np.int32)
+    np.testing.assert_array_equal(got, want)

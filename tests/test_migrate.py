@@ -648,9 +648,24 @@ def test_migrate_assignment_validation(rng, _devices):
         nbody.make_migrate_loop(cfg, mesh, 1)  # no vgrid
     import dataclasses as _dc
 
+    # single-device scan deposit keys by DEVICE cell (position, not vrank
+    # membership), so LPT assignment now composes with it (late round 4)
     cfg2 = _dc.replace(cfg, deposit_shape=(4, 4, 4))
+    nbody.make_migrate_loop(cfg2, mesh, 1, vgrid=vgrid)  # must not raise
+    # ...but the per-vrank-block paths still cannot serve assignment-
+    # decomposed vranks: segment-method deposit, and any multi-device mesh
+    cfg3 = _dc.replace(cfg2, deposit_method="segment")
     with pytest.raises(ValueError, match="deposit"):
-        nbody.make_migrate_loop(cfg2, mesh, 1, vgrid=vgrid)
+        nbody.make_migrate_loop(cfg3, mesh, 1, vgrid=vgrid)
+    mesh2 = mesh_lib.make_mesh(
+        ProcessGrid((2, 1, 1)), devices=jax.devices()[:2]
+    )
+    cfg4 = _dc.replace(
+        cfg2, grid=ProcessGrid((2, 1, 1)),
+        cells=ProcessGrid((2, 2, 1)), assignment=(0, 1, 0, 1),
+    )
+    with pytest.raises(ValueError, match="deposit"):
+        nbody.make_migrate_loop(cfg4, mesh2, 1, vgrid=ProcessGrid((1, 2, 1)))
 
 
 def test_plan_rows_batched_matches_vmapped(rng):
