@@ -54,7 +54,11 @@ def run(n_local: int = None, mesh_cells: int = 128,
     cfg = nbody.DriftConfig(
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,
-        deposit_shape=dshape, deposit_method="scan",
+        deposit_shape=dshape,
+        # "mxu" = the Pallas segmented-sum throughput engine (late round
+        # 4; f32-accumulation class, f64-oracle tested); BENCH_DEPOSIT=
+        # scan measures the double-float engine instead
+        deposit_method=os.environ.get("BENCH_DEPOSIT", "mxu"),
     )
     args = (
         jax.device_put(jnp.asarray(nbody.rows_to_planar(pos, mesh.size))),

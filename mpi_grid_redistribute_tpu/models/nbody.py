@@ -45,7 +45,9 @@ class DriftConfig:
     capacity: int
     n_local: int  # padded rows per shard; also the out_capacity
     deposit_shape: Optional[Tuple[int, ...]] = None  # global CIC mesh cells
-    deposit_method: str = "scan"  # "scan" (fast, double-float exact) | "segment"
+    deposit_method: str = "scan"  # "scan" (double-float exact) |
+    # "mxu" (Pallas segmented-sum throughput engine, f32 class) |
+    # "segment" (scatter-add)
     # on-device migrant budget per (vrank, step) for the vrank migrate
     # path's compact routing (None -> V * capacity); see
     # parallel.migrate.shard_migrate_vranks_fn
@@ -270,7 +272,9 @@ def make_migrate_loop(
         if (
             cfg.assignment is not None
             and cfg.deposit_shape is not None
-            and not (cfg.deposit_method == "scan" and mesh.size == 1)
+            and not (
+                cfg.deposit_method in ("scan", "mxu") and mesh.size == 1
+            )
         ):
             # the DEVICE-keyed planar deposit doesn't care which vrank a
             # particle rides in — it keys by position — so on one device
@@ -281,7 +285,7 @@ def make_migrate_loop(
                 "assignment-decomposed vranks own non-contiguous cell "
                 "sets; the block deposit assumes each device owns a "
                 "contiguous region — deposit on the canonical layout, "
-                "or use deposit_method='scan' on a single device"
+                "or use deposit_method='scan'/'mxu' on a single device"
             )
         mig = migrate.shard_migrate_vranks_fn(
             cfg.domain, cfg.grid, vgrid, cfg.capacity,
@@ -305,7 +309,7 @@ def make_migrate_loop(
 
     dep_fn = None
     if cfg.deposit_shape is not None:
-        if cfg.deposit_method == "scan":
+        if cfg.deposit_method in ("scan", "mxu"):
             # PLANAR deposit (round 4): consumes the fused component-major
             # rows directly — no in-loop [n, 3] transpose (a [64M, 3]
             # transient is a 32 GB T(8,128) allocation; round-3 verdict
@@ -314,9 +318,16 @@ def make_migrate_loop(
             # global cells, so the per-vrank ghost-block assembly (64
             # sequential dynamic-slice adds, ~54 ms of the 4.2M deposit —
             # scripts/knockout_deposit.py) vanishes into the segment sums.
-            dep_fn = deposit_lib.shard_deposit_device_planar_fn(
-                cfg.domain, cfg.grid, cfg.deposit_shape,
+            # "mxu" (late round 4): the Pallas segmented-sum kernel
+            # replaces prefix scans + bounds + boundary gathers entirely
+            # (ops/pallas_segdep.py) — throughput engine, f32-accumulation
+            # accuracy class; "scan" remains the double-float engine.
+            build = (
+                deposit_lib.shard_deposit_device_mxu_fn
+                if cfg.deposit_method == "mxu"
+                else deposit_lib.shard_deposit_device_planar_fn
             )
+            dep_fn = build(cfg.domain, cfg.grid, cfg.deposit_shape)
         elif vgrid is None:
             dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
                 cfg.domain, cfg.grid, cfg.deposit_shape,
@@ -335,6 +346,10 @@ def make_migrate_loop(
         """CIC density of a planar fused state ([K, V*n] or [K, n])."""
         pos_rows = lax.bitcast_convert_type(fused[:D, :], jnp.float32)
         valid_flat = fused[-1, :] > 0
+        if cfg.deposit_method == "mxu":
+            # unit mass: None drops the mass operand from the payload
+            # sort (the deposit's remaining dominant cost)
+            return dep_fn(pos_rows, None, valid_flat)
         if cfg.deposit_method == "scan":
             # planar path: component-major rows straight through
             return dep_fn(
