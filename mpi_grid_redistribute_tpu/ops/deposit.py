@@ -619,13 +619,18 @@ def cic_deposit_device_mxu(
     key, rel_rows = _device_keys_planar(
         pos_rows, valid, dev_lo, inv_h, dev_block
     )
-    iota = jnp.arange(m, dtype=jnp.int32)
-    operands = (key, iota) + tuple(rel_rows[d] for d in range(D))
+    # single-key UNSTABLE sort: the scan engine carries (key, iota) to
+    # pin the within-cell summation order for its cross-engine
+    # bit-identity contract; the MXU kernel's accumulation order is the
+    # matmul tree regardless, so the iota operand (and second compare
+    # key) buys nothing here. Grouping by cell — all the kernel needs —
+    # is key-only; determinism holds (fixed sort network + fixed grid).
+    operands = (key,) + tuple(rel_rows[d] for d in range(D))
     if mass is not None:
         operands = operands + (jnp.where(valid, mass, 0.0),)
-    s = jax.lax.sort(operands, num_keys=2, is_stable=False)
-    rel_s = jnp.stack(s[2 : 2 + D], axis=0)
-    mass_s = s[2 + D] if mass is not None else None
+    s = jax.lax.sort(operands, num_keys=1, is_stable=False)
+    rel_s = jnp.stack(s[1 : 1 + D], axis=0)
+    mass_s = s[1 + D] if mass is not None else None
     per_cell = pallas_segdep.segsum_sorted(
         s[0], rel_s, mass_s, n_cells, dev_block
     )

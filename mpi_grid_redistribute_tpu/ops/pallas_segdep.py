@@ -13,9 +13,10 @@ cells a key-block touches form one contiguous canvas span, so
   1. stream ``[T]``-key blocks (with their ``rel``/``mass`` payload
      rows) through VMEM; build the 2^D corner-weight channels in-kernel
      (elementwise — never materialized in HBM);
-  2. accumulate each 512-cell canvas chunk in a VMEM accumulator via a
-     ONE-HOT MATMUL on the MXU: ``acc += w @ onehot`` — duplicates
-     (many particles per cell) ADD, which is exactly the deposit;
+  2. accumulate each ``CH``-cell (128, measured) canvas chunk in a VMEM
+     accumulator via a ONE-HOT MATMUL on the MXU: ``acc += w @ onehot``
+     — duplicates (many particles per cell) ADD, which is exactly the
+     deposit;
   3. keys only ever advance, so each canvas chunk is open exactly once:
      when the stream moves past it, flush it to HBM with a pure write
      (no read-modify-write, no scatter) and zero the accumulator.
@@ -60,6 +61,34 @@ CH = 128  # canvas chunk width (lane-aligned flush unit). On-chip sweep
 #           selection products exact — worth the 14 ms.
 
 
+def _corner_weights(rel_rows, mass, vblock):
+    """Shared 2^D corner-weight channel build (clip-floor fracs, corner
+    product, optional mass multiply) — ONE definition so the kernel and
+    the XLA fallback stay numerically identical by construction.
+
+    ``rel_rows``: list of D same-shape arrays; ``mass`` broadcastable or
+    None (unit). Returns the channels stacked on a new axis 0.
+    """
+    d = len(rel_rows)
+    fracs = []
+    for dd in range(d):
+        r = rel_rows[dd]
+        i0 = jnp.clip(jnp.floor(r), 0.0, jnp.float32(vblock[dd] - 1))
+        fracs.append(jnp.clip(r - i0, 0.0, 1.0))
+    rows = []
+    for corner in itertools.product((0, 1), repeat=d):
+        w = None
+        for dd in range(d):
+            tt = fracs[dd] if corner[dd] == 1 else 1.0 - fracs[dd]
+            w = tt if w is None else w * tt
+        if mass is not None:
+            w = mass * w
+        rows.append(w)
+    if rows[0].ndim == 2:  # kernel path: [1, T] rows -> [2^D, T]
+        return jnp.concatenate(rows, axis=0)
+    return jnp.stack(rows, axis=0)  # fallback path: [N] rows -> [2^D, N]
+
+
 def _kernel(keys_ref, rel_ref, mass_ref, out_hbm, acc,
             cur_ref, sem, *,
             n_cells: int, nblocks: int, d: int, vblock, unit_mass: bool):
@@ -75,23 +104,11 @@ def _kernel(keys_ref, rel_ref, mass_ref, out_hbm, acc,
     # rows, mass multiplied last — never materialized in HBM. No
     # validity masking needed: invalid rows carry the sentinel key,
     # which matches no one-hot column.
-    fracs = []
-    for dd in range(d):
-        r = rel_ref[dd : dd + 1, :]  # [1, T]
-        i0 = jnp.clip(
-            jnp.floor(r), 0.0, jnp.float32(vblock[dd] - 1)
-        )
-        fracs.append(jnp.clip(r - i0, 0.0, 1.0))
-    rows = []
-    for corner in itertools.product((0, 1), repeat=d):
-        w = None
-        for dd in range(d):
-            tt = fracs[dd] if corner[dd] == 1 else 1.0 - fracs[dd]
-            w = tt if w is None else w * tt
-        if not unit_mass:
-            w = mass_ref[0:1, :] * w
-        rows.append(w)
-    wch = jnp.concatenate(rows, axis=0)  # [2^D, T]
+    wch = _corner_weights(
+        [rel_ref[dd : dd + 1, :] for dd in range(d)],
+        None if unit_mass else mass_ref[0:1, :],
+        vblock,
+    )  # [2^D, T]
 
     # sorted: first key is the minimum (scalar bool reads don't lower —
     # compare the int32 scalar instead)
@@ -201,22 +218,12 @@ def _segsum_tpu(keys, rel, mass, n_cells, vblock, d, interpret=False):
 
 
 def _segsum_xla(keys, rel, mass, n_cells, vblock, d):
-    """Platform fallback: identical channel VALUES, summed per cell by
-    ``segment_sum`` (scatter-add — fine on CPU, the TPU-slow path)."""
-    fracs = []
-    for dd in range(d):
-        i0 = jnp.clip(jnp.floor(rel[dd]), 0.0, float(vblock[dd] - 1))
-        fracs.append(jnp.clip(rel[dd] - i0, 0.0, 1.0))
-    rows = []
-    for corner in itertools.product((0, 1), repeat=d):
-        w = None
-        for dd in range(d):
-            tt = fracs[dd] if corner[dd] == 1 else 1.0 - fracs[dd]
-            w = tt if w is None else w * tt
-        if mass is not None:
-            w = mass * w
-        rows.append(w)
-    wch = jnp.stack(rows, axis=0)  # [2^D, N]
+    """Platform fallback: identical channel VALUES (shared
+    :func:`_corner_weights`), summed per cell by ``segment_sum``
+    (scatter-add — fine on CPU, the TPU-slow path)."""
+    wch = _corner_weights(
+        [rel[dd] for dd in range(d)], mass, vblock
+    )  # [2^D, N]
     valid = keys < n_cells
     wch = jnp.where(valid[None, :], wch, 0.0)
     seg = jnp.clip(keys, 0, n_cells)

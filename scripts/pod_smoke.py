@@ -207,6 +207,43 @@ def main() -> None:
         f"planar halo: OK ({int(np.asarray(gcount_p).sum())} ghosts, "
         f"counts identical to the row-major engine)", flush=True,
     )
+
+    # --- 5: fused deposit, MXU kernel vs double-float scan engine ------
+    # (the late-round-4 throughput engine: ops/pallas_segdep.py; first
+    # real-ICI run must prove the SHIPPED engines, so run both and
+    # cross-check)
+    rhos = {}
+    for method in ("mxu", "scan"):
+        dcfg = nbody.DriftConfig(
+            domain=domain, grid=grid, dt=1.0, capacity=mcap,
+            n_local=n_local, local_budget=budget,
+            deposit_shape=(32,) * domain.ndim, deposit_method=method,
+        )
+        dloop = nbody.make_migrate_loop(
+            dcfg, mesh, 2, deposit_each_step=True
+        )
+        dout = jax.tree.map(
+            np.asarray,
+            dloop(
+                jnp.asarray(nbody.rows_to_planar(p0, mesh.size)),
+                jnp.asarray(nbody.rows_to_planar(v0, mesh.size)),
+                jnp.asarray(alive),
+            ),
+        )
+        rho = dout[-1]
+        live = dout[2].sum()
+        assert abs(rho.sum() - live) / live < 1e-4, (
+            method, rho.sum(), live,
+        )
+        rhos[method] = rho
+    np.testing.assert_allclose(
+        rhos["mxu"], rhos["scan"], rtol=2e-5, atol=2e-5,
+        err_msg="MXU deposit kernel disagrees with the scan engine",
+    )
+    print(
+        "fused deposit (mxu + scan engines): OK (mass conserved, "
+        "engines agree)", flush=True,
+    )
     print("POD SMOKE PASSED", flush=True)
 
 

@@ -604,16 +604,27 @@ def test_segdep_kernel_matches_xla_fallback(rng):
     import jax.numpy as jnp
     from mpi_grid_redistribute_tpu.ops import pallas_segdep as sd
 
-    vblock = (8, 8, 8)
-    n_cells = 512
-    for n, density in [(10_000, 1.0), (9_000, 0.05), (4096, 0.0),
-                       (100, 1.0)]:
-        key = np.sort(
-            rng.integers(0, n_cells, size=n).astype(np.int32)
-        )
-        valid = rng.random(n) < 0.9 if density else np.zeros(n, bool)
+    for n, density, vblock in [(10_000, 1.0, (8, 8, 8)),
+                               (9_000, 0.05, (16, 16, 16)),
+                               (4096, 0.0, (8, 8, 8)),
+                               (100, 1.0, (8, 8, 8)),
+                               (5_000, 0.01, (16, 16, 16))]:
+        n_cells = int(np.prod(vblock))
+        if density:
+            # density < 1 clusters all keys into a FRACTION of the cell
+            # range, so blocks span many empty canvas chunks — the
+            # kernel's flush-forward gap handling is actually exercised
+            hot = max(1, int(n_cells * density))
+            cells = rng.choice(n_cells, size=hot, replace=False)
+            key = np.sort(cells[rng.integers(0, hot, size=n)]).astype(
+                np.int32
+            )
+            valid = rng.random(n) < 0.9
+        else:
+            key = np.zeros(n, np.int32)
+            valid = np.zeros(n, bool)
         key = np.sort(np.where(valid, key, n_cells)).astype(np.int32)
-        rel = (rng.random((3, n)) * 8).astype(np.float32)
+        rel = (rng.random((3, n)) * vblock[0]).astype(np.float32)
         mass = rng.random(n).astype(np.float32)
         a = np.asarray(
             sd._segsum_tpu(
