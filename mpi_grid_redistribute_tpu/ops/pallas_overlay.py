@@ -67,10 +67,12 @@ W = 2048  # baseline lane-block width; `overlay_scatter_planar` upgrades
 #          kept as the fallback for m not divisible by 4096.
 RMAX = 128  # update chunk (lane-aligned)
 ROWS = 16  # plane rows per chunk: 2K halves + ones + targets <= ROWS
+ROWS_Q = 32  # quarter-plane variant: 4K bytes + ones + targets <= 32
 
 
 def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
-            acc, sems, *, k: int, w: int, rmax: int):
+            acc, sems, *, k: int, w: int, rmax: int, rows: int,
+            quarter: bool):
     b = pl.program_id(0)
     base = b * w
     start = starts_ref[b]
@@ -115,7 +117,7 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
         # vector units flush denormals to zero on any copy (measured:
         # 1.28M corrupted targets of 58.7M at the first on-chip run);
         # the bias keeps every pattern a normal float for ints < 2^30
-        tgt_scr[:] = chunk[ROWS - 1 : ROWS, :].T
+        tgt_scr[:] = chunk[rows - 1 : rows, :].T
         tgt = (
             jax.lax.bitcast_convert_type(tgt_scr[:], jnp.int32)
             - jnp.int32(0x3F800000)
@@ -134,31 +136,52 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
         ).astype(jnp.float32)
         # neighbors' and sentinel targets miss every lane: no bounds
         # masking needed. Unique targets => plain accumulation.
+        # Precision: half-planes carry uint16 values (not bf16-exact) so
+        # they need HIGHEST (6 bf16 passes); quarter-planes carry bytes
+        # <= 255, EXACT in one bf16 — DEFAULT's single pass is exact for
+        # (byte x one-hot) products and single-term sums.
         acc[:] += jnp.dot(
             chunk, onehot,
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=(
+                jax.lax.Precision.DEFAULT
+                if quarter
+                else jax.lax.Precision.HIGHEST
+            ),
         )
         return _
 
     jax.lax.fori_loop(c0, c1, chunk_body, None)
 
-    # reassemble 32-bit words from the exact-integer half-planes
-    hi = acc[0:k, :].astype(jnp.int32)
-    lo = acc[k : 2 * k, :].astype(jnp.int32)
-    words = (hi << 16) | lo
+    # reassemble 32-bit words from the exact-integer planes
+    if quarter:
+        b0 = acc[0:k, :].astype(jnp.int32)
+        b1 = acc[k : 2 * k, :].astype(jnp.int32)
+        b2 = acc[2 * k : 3 * k, :].astype(jnp.int32)
+        b3 = acc[3 * k : 4 * k, :].astype(jnp.int32)
+        words = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        nhit = 4 * k
+    else:
+        hi = acc[0:k, :].astype(jnp.int32)
+        lo = acc[k : 2 * k, :].astype(jnp.int32)
+        words = (hi << 16) | lo
+        nhit = 2 * k
     if in_ref.dtype != jnp.int32:
         words = jax.lax.bitcast_convert_type(words, in_ref.dtype)
-    hit = acc[2 * k : 2 * k + 1, :] > 0.5  # ones-row matmul = hit count
+    hit = acc[nhit : nhit + 1, :] > 0.5  # ones-row matmul = hit count
     out_ref[:] = jnp.where(hit, words[0 : in_ref.shape[0], :], in_ref[:])
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "w", "rmax")
+    jax.jit, static_argnames=("interpret", "w", "rmax", "quarter")
 )
-def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX):
+def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX,
+                    quarter=False):
     k, m = flat.shape
-    kernel = functools.partial(_kernel, k=k, w=w, rmax=rmax)
+    rows = planes.shape[0]
+    kernel = functools.partial(
+        _kernel, k=k, w=w, rmax=rmax, rows=rows, quarter=quarter
+    )
     return pl.pallas_call(
         kernel,
         grid=(m // w,),
@@ -176,9 +199,9 @@ def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX):
             (k, m), flat.dtype, vma=jax.typeof(flat).vma
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, ROWS, rmax), jnp.float32),  # 2 chunk buffers
+            pltpu.VMEM((2, rows, rmax), jnp.float32),  # 2 chunk buffers
             pltpu.VMEM((rmax, 1), jnp.float32),  # transposed targets
-            pltpu.VMEM((ROWS, w), jnp.float32),  # overlay accumulator
+            pltpu.VMEM((rows, w), jnp.float32),  # overlay accumulator
             pltpu.SemaphoreType.DMA((2,)),
         ],
         # the pre-landing state is dead once the kernel has streamed it:
@@ -203,7 +226,7 @@ def _raise_on_duplicate_targets(dup) -> None:
 
 
 def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
-                           rmax=RMAX, debug_unique=None):
+                           rmax=RMAX, debug_unique=None, encoding=None):
     """Drop-in for ``flat.at[:, targets].set(cols, mode='drop')``.
 
     ``flat`` f32 or int32 ``[K, m]`` (int32 is the migrate engines' round-4
@@ -220,9 +243,28 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     ``jax.debug.callback``, which the experimental axon TPU platform does
     not support — the flag is meant for CPU/interpret validation runs of
     new callers, not production steps.
+
+    ``encoding`` selects the exact-integer plane split riding the MXU:
+    ``"half"`` — 2K uint16 rows, matmul at HIGHEST (uint16 is not
+    bf16-exact: 6 bf16 passes); ``"quarter"`` — 4K byte rows, matmul at
+    DEFAULT (bytes <= 255 ARE bf16-exact, so the single pass is exact
+    for one-hot products). Default: env ``MPI_GRID_OVERLAY_ENC`` or
+    "quarter" (on-chip A/B: see BENCH_CONFIGS.md). Both are bit-exact.
     """
     k, m = flat.shape
     p = targets.shape[0]
+    if encoding is None:
+        encoding = os.environ.get("MPI_GRID_OVERLAY_ENC", "quarter")
+    if encoding not in ("half", "quarter"):
+        # a typo'd env var silently running the slower engine would be a
+        # miserable perf hunt — fail loudly instead
+        raise ValueError(
+            f"overlay encoding must be 'half' or 'quarter', got "
+            f"{encoding!r} (check MPI_GRID_OVERLAY_ENC)"
+        )
+    quarter = encoding == "quarter"
+    rows_needed = (4 * k + 2) if quarter else (2 * k + 2)
+    rows_total = ROWS_Q if quarter else ROWS
     if debug_unique is None:
         debug_unique = os.environ.get("MPI_GRID_OVERLAY_DEBUG") == "1"
     if debug_unique and p > 1:
@@ -255,7 +297,7 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     if (
         m % w
         or m >= (1 << 30)  # target encoding bound (never denormal/NaN)
-        or 2 * k + 2 > ROWS
+        or rows_needed > rows_total
         or flat.dtype not in (jnp.float32, jnp.int32)
         or cols.dtype != flat.dtype
     ):
@@ -266,15 +308,23 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     ).astype(jnp.int32)
     # payload-carrying sort by target (the cheap reorder primitive) on the
     # RAW f32 rows — bit patterns ride as opaque payload; the exact-f32
-    # half-plane split happens after, elementwise, halving the sort width
+    # plane split happens after, elementwise, minimizing the sort width
     operands = (tgt,) + tuple(cols[i] for i in range(k))
     s = jax.lax.sort(operands, num_keys=1, is_stable=False)
     ts = s[0]
     words = jax.lax.bitcast_convert_type(
         jnp.stack(s[1:], axis=0), jnp.uint32
     )
-    hi = (words >> 16).astype(jnp.float32)  # exact: <= 65535
-    lo = (words & 0xFFFF).astype(jnp.float32)
+    if quarter:
+        payload_rows = [
+            ((words >> (8 * i)) & 0xFF).astype(jnp.float32)  # <= 255
+            for i in range(4)
+        ]
+    else:
+        payload_rows = [
+            (words >> 16).astype(jnp.float32),  # exact: <= 65535
+            (words & 0xFFFF).astype(jnp.float32),
+        ]
     p_pad = max(-(-p // rmax) * rmax, rmax)
     pad = p_pad - p
 
@@ -288,11 +338,10 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     sent_bits = jax.lax.bitcast_convert_type(sentinel + bias, jnp.float32)
     planes = jnp.concatenate(
         [
-            padk(hi, 0.0),
-            padk(lo, 0.0),
+            *[padk(r, 0.0) for r in payload_rows],
             padk(jnp.ones((1, p), jnp.float32), 0.0),  # hit-count row
-            jnp.zeros((ROWS - 2 * k - 2, p_pad), jnp.float32),
-            # targets row, LAST (the kernel reads ROWS-1)
+            jnp.zeros((rows_total - rows_needed, p_pad), jnp.float32),
+            # targets row, LAST (the kernel reads rows-1)
             jnp.concatenate(
                 [ts_bits, jnp.full((pad,), sent_bits, jnp.float32)]
             )[None, :],
@@ -312,5 +361,6 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     starts = binning.match_vma(starts, flat)
     planes = binning.match_vma(planes, flat)
     return _overlay_sorted(
-        flat, starts, planes, interpret=interpret, w=w, rmax=rmax
+        flat, starts, planes, interpret=interpret, w=w, rmax=rmax,
+        quarter=quarter,
     )

@@ -20,7 +20,10 @@ def _ref(flat, targets, cols):
 
 
 @pytest.mark.parametrize("seed", [0, 1])
-def test_overlay_matches_xla_scatter_bits(rng, seed, _devices):
+@pytest.mark.parametrize("encoding", ["quarter", "half"])
+def test_overlay_matches_xla_scatter_bits(rng, seed, encoding, _devices):
+    # both the shipped quarter (byte planes, DEFAULT matmul) and the
+    # fallback half (uint16 planes, HIGHEST) encodings must be bit-exact
     r = np.random.default_rng(seed)
     k, m, p = 7, 4 * 256, 37
     w, rmax = 256, 128
@@ -36,12 +39,25 @@ def test_overlay_matches_xla_scatter_bits(rng, seed, _devices):
     )
     out = pallas_overlay.overlay_scatter_planar(
         jnp.asarray(flat), jnp.asarray(targets), jnp.asarray(cols),
-        interpret=True, w=w, rmax=rmax,
+        interpret=True, w=w, rmax=rmax, encoding=encoding,
     )
     want = _ref(flat, targets, cols)
     np.testing.assert_array_equal(
         np.asarray(out).view(np.uint32), want.view(np.uint32)
     )
+
+
+def test_overlay_rejects_unknown_encoding(rng, _devices):
+    k, m, p = 7, 256, 8
+    r = np.random.default_rng(0)
+    flat = r.standard_normal((k, m)).astype(np.float32)
+    targets = np.arange(p, dtype=np.int32)
+    cols = r.standard_normal((k, p)).astype(np.float32)
+    with pytest.raises(ValueError, match="encoding"):
+        pallas_overlay.overlay_scatter_planar(
+            jnp.asarray(flat), jnp.asarray(targets), jnp.asarray(cols),
+            interpret=True, encoding="byte",
+        )
 
 
 def test_overlay_drop_sentinel_and_empty(rng, _devices):
