@@ -322,12 +322,34 @@ def make_migrate_loop(
             # replaces prefix scans + bounds + boundary gathers entirely
             # (ops/pallas_segdep.py) — throughput engine, f32-accumulation
             # accuracy class; "scan" remains the double-float engine.
-            build = (
-                deposit_lib.shard_deposit_device_mxu_fn
-                if cfg.deposit_method == "mxu"
-                else deposit_lib.shard_deposit_device_planar_fn
-            )
-            dep_fn = build(cfg.domain, cfg.grid, cfg.deposit_shape)
+            if cfg.deposit_method == "mxu":
+                # slab-keyed engine (late round 4): with canonical block
+                # vranks the post-redistribute state is slab-partitioned,
+                # so vrank-major keys turn the flat 64M payload sort into
+                # a batched per-slab [V, n] sort (1.69x at 64M —
+                # scripts/microbench_slab_sort.py). LPT/cells vranks
+                # break the slab invariant -> flat position-keyed engine.
+                slab_ok = (
+                    vgrid is not None
+                    and cfg.assignment is None
+                    and cfg.cells is None
+                    and all(
+                        (m // g) % v == 0
+                        for m, g, v in zip(
+                            cfg.deposit_shape,
+                            cfg.grid.shape,
+                            vgrid.shape,
+                        )
+                    )
+                )
+                dep_fn = deposit_lib.shard_deposit_device_mxu_fn(
+                    cfg.domain, cfg.grid, cfg.deposit_shape,
+                    vgrid=vgrid if slab_ok else None,
+                )
+            else:
+                dep_fn = deposit_lib.shard_deposit_device_planar_fn(
+                    cfg.domain, cfg.grid, cfg.deposit_shape
+                )
         elif vgrid is None:
             dep_fn, _ = deposit_lib.shard_deposit_fn_masked(
                 cfg.domain, cfg.grid, cfg.deposit_shape,

@@ -605,7 +605,9 @@ def cic_deposit_device_mxu(
     matmuls on the sorted stream, no prefix scans, no bounds search, no
     boundary gathers. ``mass=None`` means unit mass AND drops the mass
     operand from the payload sort (5 operands instead of 6 — the sort is
-    the remaining dominant cost, ~179 ms at 67M rows).
+    the remaining dominant cost; when rows arrive slab-partitioned, the
+    slab-keyed variant :func:`cic_deposit_vranks_mxu` halves it with a
+    batched per-slab sort).
 
     Accuracy class: f32 accumulation (deterministic, fixed order) — the
     ``segment_sum`` class, NOT the scan engine's double-float class; the
@@ -637,15 +639,222 @@ def cic_deposit_device_mxu(
     return _corner_ghost(per_cell, dev_block)
 
 
+def cic_deposit_vranks_mxu(
+    pos_rows: jax.Array,
+    mass,
+    valid: jax.Array,
+    lo_local: jax.Array,
+    inv_h: jax.Array,
+    vblock: Tuple[int, ...],
+    vgrid_shape: Tuple[int, ...],
+) -> jax.Array:
+    """Slab-keyed MXU deposit: per-vrank [V, n] sorts feed one kernel pass.
+
+    :func:`cic_deposit_device_mxu`'s remaining dominant cost is the
+    single flat payload sort at ``m = V*n`` rows (~400 ms isolated at
+    67M, scripts/microbench_slab_sort.py). Post-redistribute, slab ``v``
+    already holds only vrank ``v``'s rows — so with VRANK-MAJOR cell
+    numbering (``key = v*C + local_cell``) every slab's valid keys lie in
+    ``[v*C, (v+1)*C)`` and sorting each slab INDEPENDENTLY — one batched
+    ``[V, n]`` axis sort, 1.69x the flat sort's speed at 64M — yields
+    exactly the chunk-monotone stream :mod:`.pallas_segdep` accepts
+    (sentinels sit at slab tails, mid-stream; the kernel's min-key block
+    starts handle that). The vrank-major ``[2^D, V*C]`` canvas is then a
+    cheap 2M-column transpose away from device row-major.
+
+    ``rel`` is BLOCK-LOCAL (``(pos - lo_local[v]) * inv_h``), so the
+    kernel's floor/clip against ``vblock`` is self-consistent with the
+    key: a boundary-rounding particle (f32 cell computes one past its
+    slab's block) clamps to the block edge with frac 1, which deposits
+    onto the SHARED face node — same node the device-keyed engine
+    reaches via frac 0 from the far side, different only in the
+    ulp-sized split between the two face nodes. Within-cell summation
+    order also differs from the device-keyed engine (different sort),
+    so equality with :func:`cic_deposit_device_mxu` is tolerance-level,
+    not bit-level — same f32-accumulation accuracy class, bounded by the
+    float64-oracle test.
+
+    Returns the +1-ghost DEVICE mesh ``[*(dev_block + 1)]`` where
+    ``dev_block = vblock * vgrid_shape``.
+    """
+    key, rel, mass2, _ = _slab_keys_mxu(
+        pos_rows, mass, valid, lo_local, inv_h, vblock
+    )
+    return _slab_deposit_from_keys(key, rel, mass2, vblock, vgrid_shape)
+
+
+def _slab_keys_mxu(pos_rows, mass, valid, lo_local, inv_h, vblock):
+    """One fused pass over the slab state: vrank-major keys, block-local
+    rel rows, masked mass — AND the residence predicate (all valid rows
+    inside their slab's block, up to the boundary tolerances below) that
+    :func:`shard_deposit_device_mxu_fn` cond-routes on. Sharing the pass
+    keeps the guard ~free (the r arithmetic is computed once; a separate
+    pre-cond pass measured +25 ms at 64M).
+
+    Tolerances: migrate-binning (which decides residence) and this r use
+    different arithmetic, so a legal boundary row can compute
+    ``r == vblock`` exactly (round-to-nearest never lands PAST the edge;
+    the frac-1 clamp is then EXACT) or a few ulp below zero (clamp error
+    <= the excess). Admitting ``[-1e-4, vblock]`` keeps those on the
+    fast path with placement error <= 1e-4 cell — far under f32
+    accumulation noise — while genuinely mis-slabbed rows (>= a full
+    cell away) still trip the guard.
+    """
+    D, m = pos_rows.shape
+    V = lo_local.shape[0]
+    n = m // V
+    n_cells = math.prod(vblock)
+    strides = _row_major_strides(vblock)
+    valid2 = valid.reshape(V, n)
+    rel = []
+    cell = jnp.zeros((V, n), jnp.int32)
+    in_block = jnp.bool_(True)
+    for d in range(D):
+        r = (
+            pos_rows[d].reshape(V, n) - lo_local[:, d, None]
+        ) * inv_h[d]
+        ok_d = (~valid2) | (
+            (r >= jnp.float32(-1e-4)) & (r <= jnp.float32(vblock[d]))
+        )
+        in_block = in_block & jnp.all(ok_d)
+        r = jnp.where(valid2, r, 0.0)
+        i0_d = jnp.clip(
+            jnp.floor(r).astype(jnp.int32), 0, vblock[d] - 1
+        )
+        cell = cell + i0_d * jnp.int32(strides[d])
+        rel.append(r)
+    v_ids = jnp.arange(V, dtype=jnp.int32)[:, None]
+    key = jnp.where(
+        valid2, v_ids * n_cells + cell, V * n_cells
+    ).astype(jnp.int32)
+    mass2 = (
+        None if mass is None
+        else jnp.where(valid2, mass.reshape(V, n), 0.0)
+    )
+    return key, rel, mass2, in_block
+
+
+def _slab_deposit_from_keys(key, rel, mass2, vblock, vgrid_shape):
+    """Sort + kernel + canvas remap half of the slab engine (consumes
+    :func:`_slab_keys_mxu` outputs; split out so the builder's residence
+    cond can precompute keys once, outside the branch)."""
+    from mpi_grid_redistribute_tpu.ops import pallas_segdep
+
+    D = len(rel)
+    V, n = key.shape
+    m = V * n
+    n_cells = math.prod(vblock)
+    # batched per-slab sort: V independent n-row sorts along the lane
+    # axis — the whole point (single-key unstable, like the flat engine)
+    operands = (key,) + tuple(rel)
+    if mass2 is not None:
+        operands = operands + (mass2,)
+    s = jax.lax.sort(operands, num_keys=1, is_stable=False)
+    rel_s = jnp.stack([x.reshape(m) for x in s[1 : 1 + D]], axis=0)
+    mass_s = s[1 + D].reshape(m) if mass2 is not None else None
+    per_cell = pallas_segdep.segsum_sorted(
+        s[0].reshape(m), rel_s, mass_s, V * n_cells, vblock
+    )  # [2^D, V * n_cells], vrank-major columns
+    nch = per_cell.shape[0]
+    # vrank-major -> device row-major: [nch, Vx, Vy, Vz, bx, by, bz]
+    # -> [nch, Vx, bx, Vy, by, Vz, bz] -> [nch, X, Y, Z] (a canvas
+    # transpose — 2M columns, not 64M rows)
+    per_cell = per_cell.reshape((nch,) + tuple(vgrid_shape) + tuple(vblock))
+    axes_order = [0]
+    for d in range(D):
+        axes_order += [1 + d, 1 + D + d]
+    per_cell = per_cell.transpose(tuple(axes_order))
+    dev_block = tuple(
+        v * b for v, b in zip(vgrid_shape, vblock)
+    )
+    per_cell = per_cell.reshape((nch, math.prod(dev_block)))
+    return _corner_ghost(per_cell, dev_block)
+
+
 def shard_deposit_device_mxu_fn(
     domain: Domain,
     dev_grid: ProcessGrid,
     mesh_shape: Tuple[int, ...],
+    vgrid: ProcessGrid = None,
 ):
     """Per-device MXU deposit closure (throughput twin of
-    :func:`shard_deposit_device_planar_fn`; ``mass=None`` supported)."""
+    :func:`shard_deposit_device_planar_fn`; ``mass=None`` supported).
+
+    With ``vgrid`` (and divisible blocks), rows must arrive slab-ordered
+    — slab ``v`` holding only vrank ``v``'s particles, the fused migrate
+    loop's post-redistribute invariant — and the slab-keyed engine
+    (:func:`cic_deposit_vranks_mxu`) replaces the flat 64M sort with a
+    batched per-slab sort. Without it, the position-keyed flat engine
+    (:func:`cic_deposit_device_mxu`) makes no assumption about row order.
+    """
+    if vgrid is None:
+        return shard_deposit_device_planar_fn(
+            domain, dev_grid, mesh_shape, core=cic_deposit_device_mxu
+        )
+    full_shape = tuple(
+        d * v for d, v in zip(dev_grid.shape, vgrid.shape)
+    )
+    full_grid = ProcessGrid(full_shape, axis_names=dev_grid.axis_names)
+    _check_mesh_shape(domain, full_grid, mesh_shape)
+    ndim = domain.ndim
+    V = vgrid.nranks
+    vwidths = full_grid.cell_widths(domain)
+    vcells = np.asarray(
+        [vgrid.cell_of_rank(v) for v in range(V)], dtype=np.float32
+    )
+
+    def slab_core(pos_rows, mass, valid, dev_lo, inv_h, dev_block):
+        # a `core` for shard_deposit_device_planar_fn (which owns the
+        # dev_lo stack and fold_ghosts/assemble_dense epilogue — shared
+        # with every other deposit route by construction)
+        vblock = tuple(b // v for b, v in zip(dev_block, vgrid.shape))
+        me_cell = [
+            lax.axis_index(name).astype(jnp.int32)
+            for name in dev_grid.axis_names
+        ]
+        lo_all = jnp.stack(
+            [
+                jnp.asarray(domain.lo[a], jnp.float32)
+                + (
+                    me_cell[a].astype(jnp.float32) * vgrid.shape[a]
+                    + jnp.asarray(vcells[:, a])
+                )
+                * jnp.asarray(vwidths[a], jnp.float32)
+                for a in range(ndim)
+            ],
+            axis=1,
+        )  # [V, ndim]
+        # RESIDENCE GUARD: the slab keying is only meaningful when every
+        # valid row sits inside its slab's cell block — true post-
+        # redistribute with zero backlog, FALSE for rows a capacity
+        # backlog left on the wrong slab (or a caller feeding unsorted
+        # rows). Keying such a row by its resident slab would clamp it
+        # into the wrong cell SILENTLY, so the engine derives a
+        # residence predicate from the SAME fused pass that builds the
+        # keys (_slab_keys_mxu — a separate pre-cond pass measured
+        # +25 ms at 64M) and lax.cond-routes the whole deposit to the
+        # position-keyed flat engine — correct for any row order —
+        # whenever the invariant fails. Steady state (the measured
+        # config-5 path: backlog 0 every step) always takes the slab
+        # branch.
+        key, rel, mass2, in_block = _slab_keys_mxu(
+            pos_rows, mass, valid, lo_all, inv_h, vblock
+        )
+
+        def slab_branch():
+            return _slab_deposit_from_keys(
+                key, rel, mass2, vblock, vgrid.shape
+            )
+
+        def flat_branch():
+            return cic_deposit_device_mxu(
+                pos_rows, mass, valid, dev_lo, inv_h, dev_block
+            )
+
+        return lax.cond(in_block, slab_branch, flat_branch)
+
     return shard_deposit_device_planar_fn(
-        domain, dev_grid, mesh_shape, core=cic_deposit_device_mxu
+        domain, dev_grid, mesh_shape, core=slab_core
     )
 
 

@@ -28,9 +28,15 @@ and tested against the float64 oracle at the scan deposit's tolerance.
 The double-float scan engine remains the high-accuracy option
 (``deposit_method="scan"``); this kernel is the throughput engine.
 
-Contract: ``keys [N]`` int32 ascending with sentinel ``n_cells`` for
-invalid rows; ``rel [D, N]`` block-local coordinates in sorted order;
-``mass [N]`` sorted (or None for unit mass); returns
+Contract: ``keys [N]`` int32 CHUNK-MONOTONE with sentinel ``n_cells``
+for invalid rows — globally ascending streams qualify, and so do
+CONCATENATED PER-SLAB sorts (vrank-major keys, each slab sorted
+independently, sentinels at slab tails): the kernel only requires that
+consecutive ``T``-blocks' valid-key chunk intervals never step
+backwards (``min_chunk(block b+1) >= max_chunk(block b)``; sharing a
+chunk is fine), because a chunk, once passed, is flushed and never
+reopened. ``rel [D, N]`` block-local coordinates and ``mass [N]``
+(or None for unit mass) ride the same order. Returns
 ``per_cell [2^D, n_cells]``. Off TPU, :func:`segsum_sorted` falls back
 to an XLA ``segment_sum`` of the same channel values (same accuracy
 class; bit-equal only per-channel-value, not per-sum-order).
@@ -110,11 +116,16 @@ def _kernel(keys_ref, rel_ref, mass_ref, out_hbm, acc,
         vblock,
     )  # [2^D, T]
 
-    # sorted: first key is the minimum (scalar bool reads don't lower —
-    # compare the int32 scalar instead)
-    any_valid = k2[0, 0] < n_cells
+    # block extent from the VALID-key min/max (scalar bool reads don't
+    # lower — compare int32 scalars instead). The min-based `first`
+    # (not k2[0, 0]) is what admits CHUNK-MONOTONE streams: sentinel
+    # runs may interleave mid-stream (per-slab sorts concatenated), as
+    # long as valid keys never revisit a flushed chunk. Sentinels are
+    # n_cells, so min(k2) < n_cells iff the block has any valid key.
+    kmin = jnp.min(k2)
+    any_valid = kmin < n_cells
     kmax = jnp.max(jnp.where(k2 < n_cells, k2, -1))
-    first = lax.div(jnp.maximum(k2[0, 0], 0), jnp.int32(CH))
+    first = lax.div(kmin, jnp.int32(CH))
     last = lax.div(jnp.maximum(kmax, 0), jnp.int32(CH))
     n_chunks = (n_cells + CH - 1) // CH
     io = jax.lax.broadcasted_iota(jnp.int32, (T, CH), 1)
@@ -234,11 +245,13 @@ def _segsum_xla(keys, rel, mass, n_cells, vblock, d):
 
 def segsum_sorted(keys, rel, mass, n_cells: int, vblock,
                   interpret: bool = False):
-    """Per-cell corner-weight sums of a cell-SORTED particle stream.
+    """Per-cell corner-weight sums of a cell-sorted particle stream.
 
-    ``keys [N]`` int32 ascending (sentinel ``n_cells`` = invalid),
-    ``rel [D, N]`` sorted block-local coordinates, ``mass [N]`` sorted or
-    ``None`` (unit mass — also drops the operand upstream from the
+    ``keys [N]`` int32 CHUNK-MONOTONE (module docstring: globally
+    ascending, or concatenated per-slab sorts with sentinel runs at
+    slab tails; sentinel ``n_cells`` = invalid), ``rel [D, N]``
+    block-local coordinates riding the same order, ``mass [N]`` likewise
+    or ``None`` (unit mass — also drops the operand upstream from the
     payload sort). Returns ``[2^D, n_cells]``. The kernel engages on TPU
     (or ``interpret=True``); elsewhere the XLA ``segment_sum`` fallback
     computes the same channel values.

@@ -1,0 +1,87 @@
+"""Attribute the slab deposit's residence-guard cost at the 64M shape:
+(1) slab engine with no guard/cond, (2) the production cond with the
+fused guard predicate, (3) cond with a constant-true predicate (XLA
+folds the branch — isolates predicate cost from cond-boundary cost).
+
+Usage: python scripts/microbench_slab_guard.py
+"""
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from mpi_grid_redistribute_tpu.ops import deposit as dep
+from mpi_grid_redistribute_tpu.utils import profiling
+
+V_SHAPE = (4, 4, 4)
+V = math.prod(V_SHAPE)
+n = 1 << 20
+DEV_BLOCK = (128, 128, 128)
+vblock = tuple(b // v for b, v in zip(DEV_BLOCK, V_SHAPE))
+
+rng = np.random.default_rng(0)
+pos = np.empty((V * n, 3), np.float32)
+import itertools
+vcells = list(itertools.product(*[range(g) for g in V_SHAPE]))
+for v, vc in enumerate(vcells):
+    lo = np.asarray(vc) / np.asarray(V_SHAPE)
+    pos[v * n : (v + 1) * n] = (
+        lo + rng.random((n, 3)) / np.asarray(V_SHAPE)
+    ).astype(np.float32)
+pos_rows = jnp.asarray(np.ascontiguousarray(pos.T))
+valid = jnp.asarray(rng.random(V * n) > 0.1)
+lo_all = jnp.asarray(
+    np.asarray(vcells, np.float32) / np.asarray(V_SHAPE, np.float32)
+)
+inv_h = jnp.full(3, 128.0)
+dev_lo = jnp.zeros(3)
+
+
+def make_variant(mode):
+    def make_loop(S):
+        @jax.jit
+        def loop(pos_rows, valid):
+            def body(carry, _):
+                pr, va = carry
+                key, rel, mass2, ok = dep._slab_keys_mxu(
+                    pr, None, va, lo_all, inv_h, vblock
+                )
+                if mode == "noguard":
+                    rho = dep._slab_deposit_from_keys(
+                        key, rel, mass2, vblock, V_SHAPE
+                    )
+                else:
+                    pred = ok if mode == "cond" else jnp.bool_(True)
+                    rho = lax.cond(
+                        pred,
+                        lambda: dep._slab_deposit_from_keys(
+                            key, rel, mass2, vblock, V_SHAPE
+                        ),
+                        lambda: dep.cic_deposit_device_mxu(
+                            pr, None, va, dev_lo, inv_h, DEV_BLOCK
+                        ),
+                    )
+                # rho feeds the carry probe so the deposit is forced
+                return (pr, va), rho[0, 0, 0]
+
+            _, outs = lax.scan(body, (pos_rows, valid), None, length=S)
+            return outs
+
+        return loop
+
+    return make_loop
+
+
+for mode in ("noguard", "const", "cond"):
+    t, _, _ = profiling.scan_time_per_step(
+        make_variant(mode), (pos_rows, valid), s1=2, s2=6
+    )
+    print(f"{mode:8s}: {t * 1e3:8.2f} ms/deposit")
