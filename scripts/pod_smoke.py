@@ -244,6 +244,85 @@ def main() -> None:
         "fused deposit (mxu + scan engines): OK (mass conserved, "
         "engines agree)", flush=True,
     )
+
+    # --- 5b: vrank (slab-keyed) deposit on top of the real mesh -------
+    # (the production config-5 engine when devices are oversubscribed:
+    # per-slab sorts + chunk-monotone segdep stream + residence guard —
+    # deposit.cic_deposit_vranks_mxu; same particles, same physics, so
+    # the density must agree with the flat engines above)
+    vgrid = ProcessGrid((2, 1, 1))
+    V = vgrid.nranks
+    if n_local % V == 0 and all(
+        (32 // s) % v == 0 for s, v in zip(shape, vgrid.shape)
+    ):
+        # slab-LEGAL start: each (device, vrank) slab's rows inside its
+        # own full-grid region (reusing the flat p0 would start ~half of
+        # every device's rows on the wrong SLAB — a migration burst the
+        # 2%-sized capacities are not meant for)
+        n_slab = n_local // V
+        vshape = tuple(d * v for d, v in zip(shape, vgrid.shape))
+        vfull = ProcessGrid(vshape)
+        pv = np.empty((R * n_local, 3), np.float32)
+        i = 0
+        for d in range(R):
+            dc = grid.cell_of_rank(d)
+            for v in range(V):
+                vc = vgrid.cell_of_rank(v)
+                cell = np.asarray([
+                    dc[a] * vgrid.shape[a] + vc[a] for a in range(3)
+                ])
+                lo = cell / np.asarray(vshape)
+                pv[i : i + n_slab] = (
+                    lo + rng.random((n_slab, 3)) / np.asarray(vshape)
+                ).astype(np.float32)
+                i += n_slab
+        vscale2, mcap2, budget2 = bcommon.drift_sizing(
+            vshape, n_slab, fill, migration
+        )
+        vv = ((rng.random((R * n_local, 3)) - 0.5) * 2 * vscale2).astype(
+            np.float32
+        )
+        valive = rng.random(R * n_local) < fill
+        vrhos = {}
+        for method in ("mxu", "scan"):
+            vcfg = nbody.DriftConfig(
+                domain=domain, grid=grid, dt=1.0, capacity=mcap2,
+                n_local=n_slab, local_budget=budget2,
+                deposit_shape=(32,) * domain.ndim,
+                deposit_method=method,
+            )
+            vdloop = nbody.make_migrate_loop(
+                vcfg, mesh, 2, vgrid=vgrid, deposit_each_step=True
+            )
+            vdout = jax.tree.map(
+                np.asarray,
+                vdloop(
+                    jnp.asarray(nbody.rows_to_planar(pv, mesh.size)),
+                    jnp.asarray(nbody.rows_to_planar(vv, mesh.size)),
+                    jnp.asarray(valive),
+                ),
+            )
+            stats_lib.check_no_loss(jax.tree.map(np.asarray, vdout[3]))
+            vrho = vdout[-1]
+            vlive = vdout[2].sum()
+            assert abs(vrho.sum() - vlive) / vlive < 1e-4, (
+                method, vrho.sum(), vlive,
+            )
+            vrhos[method] = vrho
+        np.testing.assert_allclose(
+            vrhos["mxu"], vrhos["scan"], rtol=2e-5, atol=2e-5,
+            err_msg="slab-keyed vrank deposit disagrees with the scan "
+            "engine",
+        )
+        print(
+            f"slab-keyed vrank deposit (V={V}): OK (mass conserved, "
+            "agrees with the scan engine)", flush=True,
+        )
+    else:
+        print(
+            f"slab-keyed vrank deposit: SKIPPED (mesh {shape} does not "
+            f"divide for vgrid {vgrid.shape})", flush=True,
+        )
     print("POD SMOKE PASSED", flush=True)
 
 
