@@ -261,7 +261,6 @@ def main() -> None:
         # 2%-sized capacities are not meant for)
         n_slab = n_local // V
         vshape = tuple(d * v for d, v in zip(shape, vgrid.shape))
-        vfull = ProcessGrid(vshape)
         pv = np.empty((R * n_local, 3), np.float32)
         i = 0
         for d in range(R):
