@@ -985,7 +985,16 @@ def shard_migrate_vranks_fn(
         # sorted_dest_counts is 5.7 ms at 8x1M while the flat composite
         # sort alone is 9.8 ms, and the boundary lookup it then needs —
         # searchsorted(method="sort"), 72 queries over 8.4M keys — costs
-        # a pathological ~97 ms on this stack (scripts/microbench_sort.py)
+        # a pathological ~97 ms on this stack (scripts/microbench_sort.py).
+        # ALSO REJECTED (late round 4): lax.top_k with k = plan capacity
+        # on a packed descending key — the order below is only consumed
+        # up to the first `leavers` entries, so a truncated selection
+        # would suffice semantically, but top_k lowers 2-3.7x SLOWER
+        # than the full packed sort (14.3 vs 3.8 ms at 8x1M, 116.2 vs
+        # 57.1 at 64x1M — scripts/microbench_topk.py); a Pallas stream
+        # compaction was sketched and dropped: within-chunk placement
+        # needs a [T, T] one-hot whose VPU construction (~275G elem ops
+        # at 64M) dwarfs the sort it would replace.
         order, counts, bounds = jax.vmap(
             lambda k: binning.sorted_dest_counts(k, R_total)
         )(dest_key)  # [V, n], [V, R_total], [V, R_total + 1]
