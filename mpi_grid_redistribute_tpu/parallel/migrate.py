@@ -989,12 +989,13 @@ def shard_migrate_vranks_fn(
         # ALSO REJECTED (late round 4): lax.top_k with k = plan capacity
         # on a packed descending key — the order below is only consumed
         # up to the first `leavers` entries, so a truncated selection
-        # would suffice semantically, but top_k lowers 2-3.7x SLOWER
-        # than the full packed sort (14.3 vs 3.8 ms at 8x1M, 116.2 vs
-        # 57.1 at 64x1M — scripts/microbench_topk.py); a Pallas stream
-        # compaction was sketched and dropped: within-chunk placement
-        # needs a [T, T] one-hot whose VPU construction (~275G elem ops
-        # at 64M) dwarfs the sort it would replace.
+        # would suffice semantically, but top_k lowers 2-5.8x SLOWER
+        # than the full packed sort (both packing in-loop: 14.6 vs
+        # 2.5 ms at 8x1M, 111.2 vs 56.8 at 64x1M —
+        # scripts/microbench_topk.py); a Pallas stream compaction was
+        # sketched and dropped: within-chunk placement needs a [T, T]
+        # one-hot whose VPU construction (~275G elem ops at 64M) dwarfs
+        # the sort it would replace.
         order, counts, bounds = jax.vmap(
             lambda k: binning.sorted_dest_counts(k, R_total)
         )(dest_key)  # [V, n], [V, R_total], [V, R_total + 1]
