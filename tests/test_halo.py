@@ -332,6 +332,30 @@ def test_vrank_halo_matches_shard_map(rng):
         np.testing.assert_array_equal(a, b)
 
 
+
+def _assert_planar_matches_rowmajor(res, count, rpos, rcount, rover,
+                                    grid, domain, w, H, G):
+    """Planar engine vs row-major reference on the same redistributed
+    state: identical overflow counters, ghost counts, and per-rank ghost
+    position bits (shared by the width and overflow parametrizations)."""
+    R = grid.nranks
+    oc = np.asarray(res.positions).shape[0] // R
+    fused = np.ascontiguousarray(
+        np.asarray(res.positions).reshape(R, oc, 3).transpose(0, 2, 1)
+    )
+    hp = halo_lib.build_halo_planar_vranks(domain, grid, w, H, G)
+    gplanar, pcount, pover = hp(fused, count)
+    np.testing.assert_array_equal(np.asarray(pcount), np.asarray(rcount))
+    np.testing.assert_array_equal(np.asarray(pover), np.asarray(rover))
+    gplanar = np.asarray(gplanar)
+    for r in range(R):
+        g = int(np.asarray(rcount)[r])
+        np.testing.assert_array_equal(
+            gplanar[r, :3, :g].T.view(np.uint32),
+            np.asarray(rpos)[r, :g].view(np.uint32),
+        )
+
+
 @pytest.mark.parametrize("w", [0.2, 0.25, 0.3])
 def test_planar_halo_band_widths_bitlevel(rng, w):
     """Both planar selection paths — the merged single-banded-sort axis
@@ -356,17 +380,32 @@ def test_planar_halo_band_widths_bitlevel(rng, w):
         np.asarray(res.positions).reshape(R, oc, 3), count
     )
     assert int(np.asarray(rover).sum()) == 0
-    fused = np.ascontiguousarray(
-        np.asarray(res.positions).reshape(R, oc, 3).transpose(0, 2, 1)
+    _assert_planar_matches_rowmajor(
+        res, count, rpos, rcount, rover, grid, domain, w, H, G
     )
-    hp = halo_lib.build_halo_planar_vranks(domain, grid, w, H, G)
-    gplanar, pcount, pover = hp(fused, count)
-    np.testing.assert_array_equal(np.asarray(pcount), np.asarray(rcount))
-    np.testing.assert_array_equal(np.asarray(pover), np.asarray(rover))
-    gplanar = np.asarray(gplanar)
-    for r in range(R):
-        g = int(np.asarray(rcount)[r])
-        np.testing.assert_array_equal(
-            gplanar[r, :3, :g].T.view(np.uint32),
-            np.asarray(rpos)[r, :g].view(np.uint32),
-        )
+
+
+@pytest.mark.parametrize("w", [0.2, 0.3])
+def test_planar_halo_overflow_parity_bitlevel(rng, w):
+    """Under TIGHT capacities (overflowing passes and ghost buffer) the
+    planar engine — merged banded-sort path (w=0.2) and two-sort
+    fallback (w=0.3) — clips exactly like the row-major engine:
+    identical overflow counters, ghost counts, and ghost bits."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 512
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=4.0,
+                          out_capacity=2 * n_local)
+    res = rd.redistribute(pos)
+    oc = res.positions.shape[0] // R
+    count = np.asarray(res.count)
+    H, G = 64, 160  # far below the shell population -> overflow
+    hv = halo_lib.build_halo_vranks(domain, grid, w, H, G)
+    rpos, rcount, rover = hv(
+        np.asarray(res.positions).reshape(R, oc, 3), count
+    )
+    assert int(np.asarray(rover).sum()) > 0  # the regime under test
+    _assert_planar_matches_rowmajor(
+        res, count, rpos, rcount, rover, grid, domain, w, H, G
+    )
