@@ -61,10 +61,13 @@ from jax.experimental.pallas import tpu as pltpu
 from mpi_grid_redistribute_tpu.ops import binning
 
 W = 2048  # baseline lane-block width; `overlay_scatter_planar` upgrades
-#          to 4096 whenever m divides (round-4 on-chip sweep with the
-#          double-buffered chunk DMA: 3.93 ms at 4096 vs 7.45 at 2048 on
-#          the 8.4M headline landing; 73.4 vs 74.1 ms at 64M). 2048 is
-#          kept as the fallback for m not divisible by 4096.
+#          to 4096 whenever 4096 divides m, and to 8192 whenever 8192
+#          divides m AND m >= 2^24 (round-4 end sweeps, double-buffered kernel +
+#          quarter encoding: 8.4M headline landing 3.93 ms at 4096 vs
+#          4.03 at 8192 — a tie — but 34.7 vs 59.4 ms at the 64M
+#          north-star, where halving the 16k block count halves the
+#          per-block overhead). 2048 is the fallback for m not
+#          divisible by 4096.
 RMAX = 128  # update chunk (lane-aligned)
 ROWS = 16  # plane rows per chunk: 2K halves + ones + targets <= ROWS
 ROWS_Q = 32  # quarter-plane variant: 4K bytes + ones + targets <= 32
@@ -287,13 +290,21 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
         else:
             _raise_on_duplicate_targets(dup_val)
     if w is None:
-        # with the double-buffered chunk DMA, W=4096 wins at every
-        # measured size: 3.93 ms vs 7.45 at 2048 on the 8.4M headline
-        # landing (scripts/microbench_overlay.py) and 75.7 vs 86.7 ms at
-        # the 64M north-star (scripts/microbench_overlay_ns.py, single-
-        # buffered; the db kernel is re-swept there too). An explicit
-        # ``w`` is honored verbatim (the microbench sweeps depend on it).
-        w = 4096 if m % 4096 == 0 else W
+        # size-dependent width (round-4 end sweeps, double-buffered
+        # kernel + quarter encoding + dense starts): at the 8.4M
+        # headline landing W=4096 and 8192 tie (3.93 vs 4.03 ms,
+        # scripts/microbench_overlay.py) but at the 64M north-star
+        # landing W=8192 wins 1.7x (34.7 vs 59.4 ms,
+        # scripts/microbench_overlay_ns.py) — halving the block count
+        # halves the per-block overhead (acc zero / reassembly / blend)
+        # that dominates at 16k blocks. An explicit ``w`` is honored
+        # verbatim (the microbench sweeps depend on it).
+        if m % 8192 == 0 and m >= (1 << 24):
+            w = 8192
+        elif m % 4096 == 0:
+            w = 4096
+        else:
+            w = W
     if (
         m % w
         or m >= (1 << 30)  # target encoding bound (never denormal/NaN)

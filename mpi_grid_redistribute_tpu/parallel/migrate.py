@@ -686,7 +686,8 @@ def _plan_rows(seg_starts, seg_counts, order, length: int):
     return order[jnp.clip(pos, 0, n - 1)], cum[-1]
 
 
-def _plan_rows_batched(seg_starts, seg_counts, order, length: int):
+def _plan_rows_batched(seg_starts, seg_counts, order, length: int,
+                       seg_rows=None):
     """Batched :func:`_plan_rows` over a leading vrank axis, with every
     gather LINEARIZED into one wide-minor ``jnp.take(..., axis=1)``.
 
@@ -697,9 +698,21 @@ def _plan_rows_batched(seg_starts, seg_counts, order, length: int):
     gather's pattern, phase 5). Inputs: ``seg_starts``/``seg_counts``
     [V, S], ``order`` [V, n]; returns ``(vacated [V, length],
     totals [V])``.
+
+    ``seg_rows`` ([S] int32, round 4 — arrival plans): maps each segment
+    to the row of ``order`` it reads — segments of one plan row may live
+    in *different* rows (dst ``w`` reads source ``s``'s sorted space at
+    segment ``s -> w``). The row index telescopes through the same mask
+    (values < S << 2^24, exact in f32) and combines with the local
+    position in int32 — positions themselves never exceed n, so the f32
+    exactness bound of the einsum is untouched. Returned entries are
+    GLOBALIZED: ``seg_row * n + order[seg_row, pos]`` (the [V, length]
+    ``row_g * n`` add is O(V*M); pre-globalizing ``order`` instead
+    would materialize an O(V*n) temp per step). Default: plan row v
+    reads ``order[v]``, values raw.
     """
     V, S = seg_counts.shape
-    n = order.shape[1]
+    n = order.shape[-1]
     cum = jnp.concatenate(
         [
             jnp.zeros((V, 1), jnp.int32),
@@ -744,14 +757,30 @@ def _plan_rows_batched(seg_starts, seg_counts, order, length: int):
         )
     ).astype(jnp.int32)  # cum[:, 0] == 0
     pos = starts_g + (j[None, :] - cum_g)
-    v_off = jnp.arange(V, dtype=jnp.int32)[:, None]
+    if seg_rows is not None:
+        d_row = jnp.diff(
+            jnp.concatenate(
+                [seg_rows, seg_rows[-1:]]
+            ).astype(jnp.float32)
+        )  # [S]: seg_rows[s+1] - seg_rows[s] (last diff 0 = clamp)
+        row_g = (
+            jnp.asarray(seg_rows[0], jnp.float32)
+            + jnp.einsum(
+                "vjs,s->vj", mask, d_row,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        ).astype(jnp.int32)  # [V, length]
+        idx = row_g * n + jnp.clip(pos, 0, n - 1)
+    else:
+        v_off = jnp.arange(V, dtype=jnp.int32)[:, None]
+        idx = v_off * n + jnp.clip(pos, 0, n - 1)
     # 1-D index vector: the fast axis-1 take lowering keys off flat
     # indices (2-D index arrays fall back to the ~33 ns/elem gather)
     vac = jnp.take(
-        order.reshape(1, -1),
-        (v_off * n + jnp.clip(pos, 0, n - 1)).reshape(-1),
-        axis=1,
+        order.reshape(1, -1), idx.reshape(-1), axis=1
     ).reshape(V, length)
+    if seg_rows is not None:
+        vac = row_g * n + vac
     return vac, cum[:, -1]
 
 
@@ -1215,30 +1244,49 @@ def shard_migrate_vranks_fn(
                 [loc_starts, bounds[:, :R_total]], axis=1
             )
             seg_counts = jnp.concatenate([allowed, rem_sent_full], axis=1)
+            vacated, _tot = _plan_rows_batched(
+                seg_starts, seg_counts, order, P
+            )  # [V, P] (linearized — vmapped gathers cost ~33 ns/elem)
+        elif P <= n:
+            # UNCLIPPED fast path (single-device): stayers sort to the
+            # END (sentinel key R_total), so leavers are a PREFIX of
+            # sorted space grouped by dest, and `eff`'s budget cap is a
+            # prefix truncation — when the grant phase clips nothing
+            # (allowed == eff, the steady-state common case) the slow
+            # plan's positions reduce to pos[v, j] = j exactly, i.e.
+            # vacated IS order[:, :P]. The telescoped-einsum plan + its
+            # ~19 ns/element order[pos] take (round-4 north-star
+            # knockout: +30 ms, the phase-4 floor) collapse to one
+            # slice. Entries beyond sum(allowed) differ between the
+            # branches but are never read (every consumer masks at
+            # k < n_sent). Clipped steps take the exact slow path.
+            unclipped = jnp.all(allowed == eff)
+            vacated = lax.cond(
+                unclipped,
+                lambda: lax.slice_in_dim(order, 0, P, axis=1),
+                lambda: _plan_rows_batched(
+                    loc_starts, allowed, order, P
+                )[0],
+            )
         else:
-            seg_starts = loc_starts
-            seg_counts = allowed
-        vacated, _tot = _plan_rows_batched(
-            seg_starts, seg_counts, order, P
-        )  # [V, P] (linearized takes — vmapped gathers cost ~33 ns/elem)
+            vacated, _tot = _plan_rows_batched(
+                loc_starts, allowed, order, P
+            )
 
         # ---- local arrivals: one column gather sized to the budget ----
         # dst w's arrivals: sources in order, first allowed[s, w] rows of
         # each (s -> w) segment; arrival columns are globally indexed so
         # one flat gather serves every vrank.
-        cumA = jnp.concatenate(
-            [jnp.zeros((1, V), jnp.int32), jnp.cumsum(allowed, axis=0)]
-        )  # [V_src+1, V_dst]
-        j = jnp.arange(M, dtype=jnp.int32)
-
-        def arr_plan(w):
-            cum = cumA[:, w]
-            s = jnp.clip(_segment_of(j, cum), 0, V - 1)
-            pos = loc_starts[s, w] + (j - cum[s])
-            row = order[s, jnp.clip(pos, 0, n - 1)]
-            return s * n + row  # [M] global source columns
-
-        arr_src = jax.vmap(arr_plan)(my_v)  # [V_dst, M]
+        # dst w's plan walks SOURCE s's sorted space at segment (s -> w):
+        # same telescoped/flat-take machinery as the vacated plan
+        # (seg_rows maps segment s to order row s and globalizes the
+        # result to s * n + row; the vmapped `order[s, pos]` form this
+        # replaces pays the ~33 ns/element batched-gather toll — the
+        # round-4 knockout hid it inside the in-context landing phase).
+        arr_src, _ = _plan_rows_batched(
+            loc_starts.T, allowed.T, order, M,
+            seg_rows=jnp.arange(V, dtype=jnp.int32),
+        )  # [V_dst, M] global source columns
         arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
             K, V, M
         )

@@ -758,3 +758,54 @@ def test_sorted_dest_counts_packed_fallback_boundary(rng):
         ).astype(np.int32)
         assert np.array_equal(np.asarray(o), ordr), n_dest
         assert np.array_equal(np.asarray(b), bounds), n_dest
+
+
+def test_vacated_prefix_fast_path_identity(rng):
+    """The unclipped vacated-slot fast path (round 4) rests on an exact
+    identity: with stayers sorted to the END (sentinel dest key) and
+    ``allowed == eff`` (prefix-truncated full counts), the slow plan's
+    positions are pos[v, j] = j, so the plan IS ``order[:, :P]``.
+    Verify bit-for-bit on sorted-dest instances, and that one clipped
+    pair breaks the identity (the engine's cond then takes the slow
+    path)."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.ops import binning
+    from mpi_grid_redistribute_tpu.parallel import migrate
+
+    V, n, n_dest, M = 5, 512, 5, 96
+    dest = rng.integers(0, n_dest, size=(V, n)).astype(np.int32)
+    self_id = np.arange(V, dtype=np.int32)
+    # mark ~90% as staying (sentinel key n_dest), like the real engine
+    stay = rng.random((V, n)) < 0.9
+    key = np.where(stay, n_dest, dest).astype(np.int32)
+    order, counts, bounds = jax.vmap(
+        lambda k: binning.sorted_dest_counts(k, n_dest)
+    )(jnp.asarray(key))
+    loc_starts = np.asarray(bounds)[:, :n_dest].astype(np.int32)
+    full = np.asarray(counts).astype(np.int32)
+    # eff = prefix truncation of full counts at budget M (engine formula)
+    rel_start = loc_starts - loc_starts[:, :1]
+    rel_end = rel_start + full
+    eff = np.clip(np.minimum(rel_end, M) - np.minimum(rel_start, M), 0,
+                  None).astype(np.int32)
+    P = M
+    slow, tot = migrate._plan_rows_batched(
+        jnp.asarray(loc_starts), jnp.asarray(eff), jnp.asarray(order), P
+    )
+    slow, tot = np.asarray(slow), np.asarray(tot)
+    fast = np.asarray(order)[:, :P]
+    for v in range(V):
+        k = min(int(tot[v]), P)
+        assert np.array_equal(slow[v, :k], fast[v, :k]), v
+    # clip one mid-plan pair -> identity must break for that vrank
+    clipped = eff.copy()
+    v_bad, w_bad = 2, 1
+    if clipped[v_bad, w_bad] > 1:
+        clipped[v_bad, w_bad] -= 1
+        slow2, tot2 = migrate._plan_rows_batched(
+            jnp.asarray(loc_starts), jnp.asarray(clipped),
+            jnp.asarray(order), P
+        )
+        slow2, tot2 = np.asarray(slow2), np.asarray(tot2)
+        k = min(int(tot2[v_bad]), P)
+        assert not np.array_equal(slow2[v_bad, :k], fast[v_bad, :k])
