@@ -54,52 +54,54 @@ def run(
     full_grid = ProcessGrid(grid_shape)
     R = full_grid.nranks
     domain = Domain(0.0, 1.0, periodic=True)
-    rng = np.random.default_rng(7)
+    # independent streams so the phases can run in either order without
+    # changing each other's data (the steady state runs FIRST — see below)
+    rng_place = np.random.default_rng(7)
+    rng = np.random.default_rng(107)
 
-    # ---- phase 1: cold-start placement via backlog drain --------------
-    pos, alive = common.lognormal_state(grid_shape, n_base, 0.5, rng,
-                                        sigma=sigma)
-    vel = np.zeros_like(pos)
-    cap = max(64, math.ceil(n_base / 16))
-    # bound the compact-routing plans: the default budget (V * capacity =
-    # 64 * cap rows/vrank) allocates GB-scale transients at 64 vranks and
-    # OOMs the chip; placement throughput is backlog-bound anyway
-    cfg = nbody.DriftConfig(
-        domain=domain, grid=dev_grid, dt=0.0, capacity=cap,
-        n_local=n_base, local_budget=4 * cap,
-    )
-    import time
+    # ---- phase 1 (runs second): cold-start placement via backlog drain
+    def run_placement():
+        pos, alive = common.lognormal_state(
+            grid_shape, n_base, 0.5, rng_place, sigma=sigma
+        )
+        vel = np.zeros_like(pos)
+        cap = max(64, math.ceil(n_base / 16))
+        # bound the compact-routing plans: the default budget (V *
+        # capacity = 64 * cap rows/vrank) allocates GB-scale transients
+        # at 64 vranks and OOMs the chip; placement throughput is
+        # backlog-bound anyway
+        cfg = nbody.DriftConfig(
+            domain=domain, grid=dev_grid, dt=0.0, capacity=cap,
+            n_local=n_base, local_budget=4 * cap,
+        )
+        import time
 
-    loop = nbody.make_migrate_loop(cfg, mesh, 8, vgrid=vgrid)
-    out = loop(pos, vel, alive)
-    np.asarray(out[2])  # compile barrier
-    placed = 0
-    t0 = time.perf_counter()
-    rounds = 0
-    state = (pos, vel, alive)
-    last = None
-    for _ in range(max_rounds // 8):
-        p, v, a, st = jax.tree.map(np.asarray, loop(*state))
-        state = (p, v, a)
-        last = st
-        rounds += 8
-        placed += int(st.sent.sum())
-        if st.sent[-1].sum() == 0:
-            break
-    dt = time.perf_counter() - t0
-    summary = stats_lib.summarize_migrate(last)
-    placement_pps = round(placed / dt, 2) if placed else 0.0
-    common.log(
-        f"config2: {placed} rows placed in {rounds} rounds "
-        f"({dt:.2f}s), imbalance {summary['population_imbalance']:.2f}"
-    )
-    # release phase 1's device state + compiled placement loop before
-    # phase 2 allocates its slabs: at BENCH_SCALE=32 the two phases
-    # together exceed HBM (measured ResourceExhausted)
-    del out, loop, state, last
-    jax.clear_caches()
+        loop = nbody.make_migrate_loop(cfg, mesh, 8, vgrid=vgrid)
+        out = loop(pos, vel, alive)
+        np.asarray(out[2])  # compile barrier
+        placed = 0
+        t0 = time.perf_counter()
+        rounds = 0
+        state = (pos, vel, alive)
+        last = None
+        for _ in range(max_rounds // 8):
+            p, v, a, st = jax.tree.map(np.asarray, loop(*state))
+            state = (p, v, a)
+            last = st
+            rounds += 8
+            placed += int(st.sent.sum())
+            if st.sent[-1].sum() == 0:
+                break
+        dt = time.perf_counter() - t0
+        summary = stats_lib.summarize_migrate(last)
+        placement_pps = round(placed / dt, 2) if placed else 0.0
+        common.log(
+            f"config2: {placed} rows placed in {rounds} rounds "
+            f"({dt:.2f}s), imbalance {summary['population_imbalance']:.2f}"
+        )
+        return summary, placement_pps, rounds
 
-    # ---- phase 2: steady-state drift throughput, imbalanced vs uniform
+    # ---- phase 2 (runs FIRST): steady-state drift, imbalanced vs uniform
     # Round 2 sized every slab by the hottest SUBDOMAIN (9.4x slot waste
     # at 7.2x imbalance — round-2 verdict item 7). Round 3 balances the
     # DECOMPOSITION instead: the 64 cells are LPT-assigned to V=8 vranks
@@ -199,8 +201,13 @@ def run(
         st = jax.tree.map(np.asarray, long_out[3])
         return per_step, st
 
+    # the AT-SIZE steady state runs on a pristine allocator (the 64M
+    # working set peaks near the chip's HBM; running the placement demo
+    # first left the measured ResourceExhausted at BENCH_SCALE=32), with
+    # a cache clear between the two measurements for the same reason
     per_c, st_c = measure(cluster_rows, owner_c, assign_c)
     dropped_c = int(st_c.dropped_recv.sum())
+    jax.clear_caches()
 
     per_u, st_u = measure(uniform_rows, owner_u, assign_u)
     dropped_u = int(st_u.dropped_recv.sum())
@@ -214,6 +221,11 @@ def run(
         f"{bins_c.max()/bins_c.mean():.3f}x, slab {n_slab}, "
         f"waste {waste:.2f}x)"
     )
+
+    # placement demo AFTER the at-size steady state, on released memory
+    del st_c, st_u
+    jax.clear_caches()
+    summary, placement_pps, rounds = run_placement()
 
     res = {
         "metric": "config2_clustered_steady_pps_per_chip",

@@ -59,13 +59,19 @@ def scan_time_per_step(
         fetch_barrier(out)  # warm: compile + first run
         best = float("inf")
         for _ in range(reps):
+            # free the previous run's output BEFORE the next invocation:
+            # at bench sizes the output pytree is GB-scale device state,
+            # and holding two generations at once was the marginal
+            # allocation in config 2's 64M ResourceExhausted
+            out = None
             t0 = time.perf_counter()
             out = loops[s](*args)
             fetch_barrier(out)
             best = min(best, time.perf_counter() - t0)
         return best, out
 
-    t1, _ = run(s1)
+    t1, out1 = run(s1)
+    del out1  # same: drop the short loop's state before the long compile
     t2, out2 = run(s2)
     per_step = (t2 - t1) / (s2 - s1)
     return per_step, t1 - per_step * s1, out2

@@ -212,26 +212,30 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             ).reshape(V, P)
             pos = starts_g + (jj[None, :] - cum_g)
             return dep_out(jnp.clip(pos, 0, n - 1))
-        vacated, _tot = migrate._plan_rows_batched(
-            loc_starts, allowed, order, P
-        )
+        # unclipped fast path mirror (late round 4): one cond + slice
+        # when the grant phase clips nothing
+        if P <= n:
+            vacated = jax.lax.cond(
+                jnp.all(allowed == eff),
+                lambda: jax.lax.slice_in_dim(order, 0, P, axis=1),
+                lambda: migrate._plan_rows_batched(
+                    loc_starts, allowed, order, P
+                )[0],
+            )
+        else:
+            vacated, _tot = migrate._plan_rows_batched(
+                loc_starts, allowed, order, P
+            )
         if phase == 4:
             return dep_out(vacated)
 
         # ---- 5: arrival gather ------------------------------------------
-        cumA = jnp.concatenate(
-            [jnp.zeros((1, V), jnp.int32), jnp.cumsum(allowed, axis=0)]
+        # telescoped seg_rows plan (late round 4) replacing the vmapped
+        # per-destination order[s, pos] gather
+        arr_src, _ = migrate._plan_rows_batched(
+            loc_starts.T, allowed.T, order, M,
+            seg_rows=jnp.arange(V, dtype=jnp.int32),
         )
-        j = jnp.arange(M, dtype=jnp.int32)
-
-        def arr_plan(w):
-            cum = cumA[:, w]
-            s = jnp.clip(migrate._segment_of(j, cum), 0, V - 1)
-            pos = loc_starts[s, w] + (j - cum[s])
-            row = order[s, jnp.clip(pos, 0, n - 1)]
-            return s * n + row
-
-        arr_src = jax.vmap(arr_plan)(my_v)
         arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
             K, V, M
         )

@@ -809,3 +809,47 @@ def test_vacated_prefix_fast_path_identity(rng):
         slow2, tot2 = np.asarray(slow2), np.asarray(tot2)
         k = min(int(tot2[v_bad]), P)
         assert not np.array_equal(slow2[v_bad, :k], fast[v_bad, :k])
+
+
+def test_plan_rows_batched_seg_rows_matches_reference(rng):
+    """``seg_rows`` mode (round 4 — the arrival plan): segments of one
+    plan row read DIFFERENT rows of ``order`` and values come back
+    globalized as ``s * n + order[s, pos]``. Reference = the vmapped
+    per-destination formulation it replaced, written plainly in NumPy."""
+    import jax.numpy as jnp
+    from mpi_grid_redistribute_tpu.parallel import migrate
+
+    for V, n, M in [(4, 257, 64), (8, 1024, 300), (3, 50, 40)]:
+        # per-source segment starts/counts as the engine lays them out:
+        # loc_starts[s, w] = start of (s -> w) in source s's sorted
+        # space; allowed[s, w] = granted rows of that segment
+        counts = rng.integers(0, 20, size=(V, V)).astype(np.int32)
+        starts = np.cumsum(
+            np.concatenate(
+                [rng.integers(0, 3, size=(V, 1)), counts[:, :-1]], axis=1
+            ),
+            axis=1,
+        ).astype(np.int32)
+        allowed = np.minimum(
+            counts, rng.integers(0, 20, size=(V, V))
+        ).astype(np.int32)
+        order = np.stack(
+            [rng.permutation(n).astype(np.int32) for _ in range(V)]
+        )
+        got, tot = migrate._plan_rows_batched(
+            jnp.asarray(starts.T), jnp.asarray(allowed.T),
+            jnp.asarray(order), M,
+            seg_rows=jnp.arange(V, dtype=jnp.int32),
+        )
+        got, tot = np.asarray(got), np.asarray(tot)
+        for w in range(V):
+            # reference: walk sources in order, take the first
+            # allowed[s, w] rows of each (s -> w) segment
+            ref = []
+            for s in range(V):
+                for k in range(int(allowed[s, w])):
+                    p = min(max(int(starts[s, w]) + k, 0), n - 1)
+                    ref.append(s * n + int(order[s, p]))
+            k = min(len(ref), M)
+            assert tot[w] == len(ref), (V, w)
+            assert np.array_equal(got[w, :k], np.asarray(ref[:k])), (V, w)
