@@ -10,7 +10,7 @@ sort-by-destination -> all-to-all pipeline, SPMD on ICI instead of mpi4py
 ``Alltoallv`` on an MPI fabric.
 """
 
-from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.domain import Domain, GridEdges, ProcessGrid
 from mpi_grid_redistribute_tpu.api import (
     GridRedistribute,
     RedistributeResult,
@@ -22,6 +22,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Domain",
+    "GridEdges",
     "ProcessGrid",
     "GridRedistribute",
     "RedistributeResult",

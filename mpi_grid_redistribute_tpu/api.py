@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.domain import Domain, GridEdges, ProcessGrid
 from mpi_grid_redistribute_tpu import oracle
 from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
 
@@ -141,13 +141,14 @@ def _zero_overflow_counters():
 
 @functools.lru_cache(maxsize=64)
 def _build_planar_vranks_call(
-    domain: Domain, grid: ProcessGrid, cap: int, out_cap: int, specs
+    domain: Domain, grid: ProcessGrid, cap: int, out_cap: int, specs,
+    edges=None,
 ):
     """One jitted program: boundary fuse -> planar vrank exchange ->
     boundary unfuse (single dispatch per call)."""
     V = grid.nranks
     engine = exchange.vrank_redistribute_planar_fn(
-        domain, grid, cap, out_cap, domain.ndim
+        domain, grid, cap, out_cap, domain.ndim, edges=edges
     )
 
     def call(positions, count, *fields):
@@ -164,13 +165,14 @@ def _build_planar_vranks_call(
 
 @functools.lru_cache(maxsize=64)
 def _build_planar_mesh_call(
-    mesh, domain: Domain, grid: ProcessGrid, cap: int, out_cap: int, specs
+    mesh, domain: Domain, grid: ProcessGrid, cap: int, out_cap: int, specs,
+    edges=None,
 ):
     """One jitted program: boundary fuse -> shard_map planar exchange ->
     boundary unfuse (single dispatch per call)."""
     R = grid.nranks
     sharded = exchange.shard_redistribute_planar_sharded(
-        mesh, domain, grid, cap, out_cap, domain.ndim
+        mesh, domain, grid, cap, out_cap, domain.ndim, edges=edges
     )
 
     def call(positions, count, *fields):
@@ -254,6 +256,13 @@ class GridRedistribute:
         ``'rowmajor'`` forces the round-2 layout (kept for comparison and
         for non-32-bit payloads). Both produce bit-identical results —
         same routing, same Alltoallv receive order, oracle-tested.
+      edges: optional :class:`~.domain.GridEdges` — NON-UNIFORM per-axis
+        subdomain boundaries (the reference family's ``np.digitize`` /
+        searchsorted-on-edges variant, SURVEY.md C1/C2). Ownership,
+        routing, the oracle backend and :func:`oracle.assert_ownership`
+        all honor the edges; uniform cells remain the default. Build
+        load-balancing edges from sample data with
+        :meth:`GridEdges.balanced_for`.
     """
 
     def __init__(
@@ -272,6 +281,7 @@ class GridRedistribute:
         on_overflow: str = "grow",
         check_every: int = 16,
         engine: str = "auto",
+        edges=None,
     ):
         self.domain = _as_domain(domain, lo, hi, periodic)
         if grid is None:
@@ -280,6 +290,13 @@ class GridRedistribute:
             grid if isinstance(grid, ProcessGrid) else ProcessGrid(tuple(grid))
         )
         self.grid.validate_against(self.domain)
+        if edges is not None and not isinstance(edges, GridEdges):
+            # mirror the grid coercion above: a raw per-axis sequence of
+            # boundary tuples wraps into GridEdges
+            edges = GridEdges(edges)
+        self.edges = edges
+        if edges is not None:
+            edges.validate_against(self.domain, self.grid)
         if backend not in ("jax", "numpy"):
             raise ValueError(f"backend must be 'jax' or 'numpy', got {backend!r}")
         self.backend = backend
@@ -427,6 +444,7 @@ class GridRedistribute:
                     list(fields),
                     cap,
                     out_cap,
+                    edges=self.edges,
                 )
             )
             return RedistributeResult(
@@ -450,11 +468,13 @@ class GridRedistribute:
             # engines and the oracle.
             if self._vranks:
                 fn = _build_planar_vranks_call(
-                    self.domain, self.grid, cap, out_cap, specs
+                    self.domain, self.grid, cap, out_cap, specs,
+                    edges=self.edges,
                 )
             else:
                 fn = _build_planar_mesh_call(
-                    self.mesh, self.domain, self.grid, cap, out_cap, specs
+                    self.mesh, self.domain, self.grid, cap, out_cap, specs,
+                    edges=self.edges,
                 )
             pos_out, new_count, fields_out, stats = fn(
                 positions, count, *fields
@@ -464,7 +484,7 @@ class GridRedistribute:
             R = self.nranks
             n_local = positions.shape[0] // R
             fn = exchange.build_redistribute_vranks(
-                self.domain, self.grid, cap, out_cap
+                self.domain, self.grid, cap, out_cap, self.edges
             )
             out = fn(
                 positions.reshape(R, n_local, -1),
@@ -479,7 +499,8 @@ class GridRedistribute:
                 out[-1],
             )
         fn = exchange.build_redistribute(
-            self.mesh, self.domain, self.grid, cap, out_cap, len(fields)
+            self.mesh, self.domain, self.grid, cap, out_cap, len(fields),
+            self.edges,
         )
         out = fn(positions, count, *fields)
         return RedistributeResult(

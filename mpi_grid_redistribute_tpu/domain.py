@@ -186,3 +186,145 @@ class ProcessGrid:
         lo = tuple(domain.lo[a] + cell[a] * w[a] for a in range(self.ndim))
         hi = tuple(domain.lo[a] + (cell[a] + 1) * w[a] for a in range(self.ndim))
         return lo, hi
+
+
+@dataclasses.dataclass(frozen=True)
+class GridEdges:
+    """Non-uniform per-axis subdomain boundaries (SURVEY.md C1/C2's
+    "np.digitize / searchsorted on edges" variant of the digitize).
+
+    ``edges[axis]`` is a strictly increasing tuple of ``shape[axis] + 1``
+    floats spanning exactly ``[domain.lo[axis], domain.hi[axis]]``; cell
+    ``k`` on that axis owns ``[edges[k], edges[k+1])``. Non-uniform edges
+    are the classic load-balancing complement to the LPT cell->rank
+    assignment (``parallel.migrate.balanced_assignment``): instead of
+    re-assigning uniform cells to ranks by measured load, the subdomain
+    *boundaries themselves* move so each rank's box holds ~equal rows.
+
+    Frozen + hashable (tuples only) so instances can parameterize the
+    ``lru_cache``d exchange builders and close over ``jax.jit`` traces as
+    static metadata, exactly like :class:`Domain` / :class:`ProcessGrid`.
+
+    Scope: consumed by the canonical redistribute path (``GridRedistribute``
+    / ``parallel.exchange`` / ``oracle``) via ``ops.binning``'s
+    ``edges=`` parameter. The drift/migrate engines and the halo exchange
+    keep uniform cells (their per-axis arithmetic is fused into Pallas
+    kernels; pair non-uniform ownership with ``DriftConfig.assignment``
+    there instead).
+    """
+
+    edges: Tuple[Tuple[float, ...], ...]
+
+    def __init__(self, edges: Sequence[Sequence[float]]):
+        object.__setattr__(
+            self,
+            "edges",
+            tuple(tuple(float(v) for v in ax) for ax in edges),
+        )
+        for a, ax in enumerate(self.edges):
+            if len(ax) < 2:
+                raise ValueError(
+                    f"edges axis {a}: need >= 2 boundaries, got {len(ax)}"
+                )
+            # `not (a < b)` — NOT `a >= b` — so NaN boundaries fail too
+            # (all NaN comparisons are False and would silently pass the
+            # >= form, then vanish from the compare-sum digitize)
+            if any(
+                not (ax[i] < ax[i + 1]) for i in range(len(ax) - 1)
+            ):
+                raise ValueError(
+                    f"edges axis {a} must be strictly increasing and "
+                    f"NaN-free, got {ax}"
+                )
+
+    @property
+    def ndim(self) -> int:
+        return len(self.edges)
+
+    def validate_against(self, domain: Domain, grid: ProcessGrid) -> None:
+        grid.validate_against(domain)
+        if self.ndim != grid.ndim:
+            raise ValueError(
+                f"edges ndim {self.ndim} != grid ndim {grid.ndim}"
+            )
+        for a, ax in enumerate(self.edges):
+            if len(ax) != grid.shape[a] + 1:
+                raise ValueError(
+                    f"edges axis {a}: {len(ax)} boundaries for "
+                    f"{grid.shape[a]} cells (need shape+1)"
+                )
+            if ax[0] != domain.lo[a] or ax[-1] != domain.hi[a]:
+                raise ValueError(
+                    f"edges axis {a} must span [{domain.lo[a]}, "
+                    f"{domain.hi[a]}] exactly, got [{ax[0]}, {ax[-1]}]"
+                )
+
+    def subdomain_of_rank(self, rank: int, grid: ProcessGrid):
+        """(lo, hi) bounds of ``rank``'s owned subvolume under these edges."""
+        cell = grid.cell_of_rank(rank)
+        lo = tuple(self.edges[a][cell[a]] for a in range(self.ndim))
+        hi = tuple(self.edges[a][cell[a] + 1] for a in range(self.ndim))
+        return lo, hi
+
+    @staticmethod
+    def balanced_for(
+        domain: Domain, grid: ProcessGrid, positions
+    ) -> "GridEdges":
+        """Edges placing ~equal row counts per slab along each axis
+        (independent per-axis quantiles of the supplied sample positions —
+        the standard recursive-bisection-style balance for product grids).
+
+        ``positions`` is a host array ``[N, ndim]``; samples are
+        periodic-wrapped into the domain first (drifted inputs are legal
+        ``redistribute`` arguments — the wrap happens inside the engine
+        too), and quantile edges are snapped to the domain bounds at the
+        ends.
+        """
+        import numpy as _np
+
+        grid.validate_against(domain)
+        shp = _np.shape(positions)
+        if len(shp) != 2 or shp[1] != grid.ndim:
+            raise ValueError(
+                f"positions must be [N, {grid.ndim}], got {shp}"
+            )
+        # one copy total (np.array always copies; asarray+copy would
+        # double the host transient at large samples)
+        pos = _np.array(positions, dtype=_np.float64)
+        for a in range(grid.ndim):
+            lo, ext = domain.lo[a], domain.extent[a]
+            if domain.periodic[a]:
+                pos[:, a] = lo + _np.remainder(pos[:, a] - lo, ext)
+            else:
+                # mirror the engine's clamp-into-edge-cells semantics so
+                # out-of-box samples on non-periodic axes cannot push
+                # quantiles outside [lo, hi]
+                pos[:, a] = _np.clip(pos[:, a], lo, lo + ext)
+        axes_edges = []
+        for a in range(grid.ndim):
+            g = grid.shape[a]
+            qs = _np.quantile(pos[:, a], _np.linspace(0.0, 1.0, g + 1))
+            qs[0], qs[-1] = domain.lo[a], domain.hi[a]
+            # Enforce strict monotonicity on degenerate samples: push
+            # colliding quantiles up from lo, then pull any that landed
+            # on hi back down (a point mass AT hi — e.g. a fully-clamped
+            # non-periodic axis — makes the upper quantiles equal hi).
+            # Point-mass samples thus yield VALID edges whose empty-ish
+            # slabs merely reflect that balance is impossible, the same
+            # best-effort behavior mid-domain atoms already got.
+            for i in range(1, g + 1):
+                if qs[i] <= qs[i - 1]:
+                    qs[i] = _np.nextafter(qs[i - 1], _np.inf)
+            qs[-1] = domain.hi[a]
+            for i in range(g - 1, 0, -1):
+                if qs[i] >= qs[i + 1]:
+                    qs[i] = _np.nextafter(qs[i + 1], -_np.inf)
+            if any(qs[i] <= qs[i - 1] for i in range(1, g + 1)):
+                # float spacing exhausted between lo and hi — only
+                # possible for absurd g or a zero-extent-scale domain
+                raise ValueError(
+                    f"axis {a}: cannot place {g} non-empty slabs in "
+                    f"[{domain.lo[a]}, {domain.hi[a]}]"
+                )
+            axes_edges.append(tuple(float(v) for v in qs))
+        return GridEdges(axes_edges)

@@ -111,13 +111,45 @@ def wrap_periodic(pos, domain: Domain, xp=jnp):
     return xp.where(per, wrapped, pos)
 
 
-def cell_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
+def _digitize_edges(p, axis_edges, xp):
+    """Compare-sum digitize of one axis: ``#{k in 1..g-1 : p >= edges[k]}``
+    — ``np.digitize(p, inner_edges)`` semantics, shared verbatim between
+    the row-major and planar paths and between the NumPy oracle and the
+    jax engines (``xp=``), so a semantics change cannot desynchronize
+    them."""
+    c = xp.zeros(p.shape, dtype=xp.int32)
+    for k in range(1, len(axis_edges) - 1):
+        b = xp.asarray(axis_edges[k], dtype=p.dtype)
+        c = c + (p >= b).astype(xp.int32)
+    return c
+
+
+def cell_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
+                     edges=None):
     """Map positions [N, ndim] to integer grid-cell coordinates [N, ndim].
 
-    Uniform cells: ``cell = floor((pos - lo) * grid_shape / extent)``, clamped
-    into [0, shape-1] so particles exactly at (or numerically beyond) the
-    upper edge land in the last cell rather than out of range.
+    Uniform cells (default): ``cell = floor((pos - lo) * grid_shape /
+    extent)``, clamped into [0, shape-1] so particles exactly at (or
+    numerically beyond) the upper edge land in the last cell rather than
+    out of range.
+
+    ``edges`` (a :class:`~..domain.GridEdges`): NON-UNIFORM boundaries —
+    ``cell = #{k in 1..g-1 : pos >= edges[k]}`` per axis, the digitize
+    semantics of ``np.digitize(pos, inner_edges)`` (cell k owns
+    ``[edges[k], edges[k+1])``; below-domain positions clamp to cell 0,
+    above-domain to the last cell). Implemented as g-1 broadcast
+    compares shared verbatim between the NumPy oracle and the jax
+    engine (``xp=``), so backend bit-compatibility holds by
+    construction — no searchsorted lowering is involved (TPU
+    ``method="sort"`` hides a full-length scatter; see
+    :func:`bounds_dense`).
     """
+    if edges is not None:
+        cols = [
+            _digitize_edges(pos[..., a], edges.edges[a], xp)
+            for a in range(grid.ndim)
+        ]
+        return xp.stack(cols, axis=-1)
     lo = xp.asarray(domain.lo, dtype=pos.dtype)
     inv_width = xp.asarray(
         [s / e for s, e in zip(grid.shape, domain.extent)], dtype=pos.dtype
@@ -133,10 +165,13 @@ def rank_of_cell(cell, grid: ProcessGrid, xp=jnp):
     return xp.sum(cell * strides, axis=-1).astype(xp.int32)
 
 
-def rank_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
+def rank_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
+                     edges=None):
     """Fused wrap -> digitize -> cell->rank map: destination rank per particle."""
     pos = wrap_periodic(pos, domain, xp=xp)
-    return rank_of_cell(cell_of_position(pos, domain, grid, xp=xp), grid, xp=xp)
+    return rank_of_cell(
+        cell_of_position(pos, domain, grid, xp=xp, edges=edges), grid, xp=xp
+    )
 
 
 def wrap_periodic_planar(pos, domain: Domain, xp=jnp):
@@ -162,24 +197,31 @@ def wrap_periodic_planar(pos, domain: Domain, xp=jnp):
     return xp.stack(out, axis=-2)
 
 
-def cell_of_position_planar(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
+def cell_of_position_planar(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
+                            edges=None):
     """Planar twin of :func:`cell_of_position`: ``[..., D, n]`` positions to
-    ``[..., D, n]`` int32 cell coordinates (same clamp semantics)."""
+    ``[..., D, n]`` int32 cell coordinates (same clamp/digitize
+    semantics, including the non-uniform ``edges`` compare-sum)."""
     out = []
     for d in range(pos.shape[-2]):
+        p = pos[..., d, :]
+        if edges is not None:
+            out.append(_digitize_edges(p, edges.edges[d], xp))
+            continue
         inv_w = xp.asarray(
             grid.shape[d] / domain.extent[d], dtype=pos.dtype
         )
         lo = xp.asarray(domain.lo[d], dtype=pos.dtype)
-        c = xp.floor((pos[..., d, :] - lo) * inv_w).astype(xp.int32)
+        c = xp.floor((p - lo) * inv_w).astype(xp.int32)
         out.append(xp.clip(c, 0, grid.shape[d] - 1))
     return xp.stack(out, axis=-2)
 
 
-def rank_of_position_planar(pos, domain: Domain, grid: ProcessGrid, xp=jnp):
+def rank_of_position_planar(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
+                            edges=None):
     """Planar twin of :func:`rank_of_position` for ``[..., D, n]`` layouts."""
     pos = wrap_periodic_planar(pos, domain, xp=xp)
-    cell = cell_of_position_planar(pos, domain, grid, xp=xp)
+    cell = cell_of_position_planar(pos, domain, grid, xp=xp, edges=edges)
     rank = None
     for d in range(cell.shape[-2]):
         t = cell[..., d, :] * xp.int32(grid.strides[d])

@@ -33,6 +33,7 @@ def redistribute_oracle(
     grid: ProcessGrid,
     pos_shards: Sequence[np.ndarray],
     field_shards: Sequence[Sequence[np.ndarray]] = (),
+    edges=None,
 ) -> Tuple[List[np.ndarray], List[List[np.ndarray]], np.ndarray]:
     """Simulate a full R-rank redistribute on the host.
 
@@ -69,7 +70,7 @@ def redistribute_oracle(
     send_rows: List[List[np.ndarray]] = []
     for s in range(R):
         dest = binning.rank_of_position(
-            np.asarray(pos_shards[s]), domain, grid, xp=np
+            np.asarray(pos_shards[s]), domain, grid, xp=np, edges=edges
         )
         rows = [np.flatnonzero(dest == d) for d in range(R)]
         send_rows.append(rows)
@@ -102,6 +103,7 @@ def redistribute_oracle_padded(
     capacity: int,
     out_capacity: int,
     native_ok: bool = True,
+    edges=None,
 ):
     """Padded-layout oracle mirroring the JAX backend's exact semantics.
 
@@ -134,12 +136,14 @@ def redistribute_oracle_padded(
         # NumPy fallback, bit-identical either way. ``native_ok=False``
         # pins the NumPy path — the reference-equivalent CPU pipeline a
         # benchmark baseline should emulate.
-        if native_ok:
+        if native_ok and edges is None:
+            # the C++ host twin digitizes uniform cells only; non-uniform
+            # edges pin the (bit-identical) NumPy branch
             dest = native.bin_positions(np.asarray(pos[sl]), domain, grid)
             dcounts, order = native.count_sort(dest, R)
         else:
             dest = binning.rank_of_position(
-                np.asarray(pos[sl]), domain, grid, xp=np
+                np.asarray(pos[sl]), domain, grid, xp=np, edges=edges
             )
             dcounts = np.bincount(dest, minlength=R + 1)[:R]
             order = np.argsort(dest, kind="stable")
@@ -189,14 +193,18 @@ def redistribute_oracle_padded(
 
 
 def assert_ownership(
-    domain: Domain, grid: ProcessGrid, pos_shards: Sequence[np.ndarray]
+    domain: Domain, grid: ProcessGrid, pos_shards: Sequence[np.ndarray],
+    edges=None,
 ) -> None:
     """Reference-style validation (SURVEY.md §3.5): every particle a rank
-    holds lies inside that rank's subdomain (after periodic wrap)."""
+    holds lies inside that rank's subdomain (after periodic wrap) — the
+    non-uniform subdomain when ``edges`` is given."""
     for r, pos in enumerate(pos_shards):
         if len(pos) == 0:
             continue
-        dest = binning.rank_of_position(np.asarray(pos), domain, grid, xp=np)
+        dest = binning.rank_of_position(
+            np.asarray(pos), domain, grid, xp=np, edges=edges
+        )
         bad = np.flatnonzero(dest != r)
         if bad.size:
             raise AssertionError(

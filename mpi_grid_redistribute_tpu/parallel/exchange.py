@@ -53,6 +53,7 @@ def shard_redistribute_fn(
     grid: ProcessGrid,
     capacity: int,
     out_capacity: int,
+    edges=None,
 ):
     """Build the per-shard function (runs under ``shard_map``).
 
@@ -67,7 +68,7 @@ def shard_redistribute_fn(
         me = lax.axis_index(axes).astype(jnp.int32)
         iota = jnp.arange(n, dtype=jnp.int32)
         valid = iota < count[0]
-        dest = binning.rank_of_position(pos, domain, grid)
+        dest = binning.rank_of_position(pos, domain, grid, edges=edges)
         dest = jnp.where(valid, dest, R).astype(jnp.int32)
         # Self-owned rows stay local (never hit the wire); the sentinel R
         # routes both invalid and self rows out of the remote pack.
@@ -117,6 +118,7 @@ def vrank_redistribute_fn(
     grid: ProcessGrid,
     capacity: int,
     out_capacity: int,
+    edges=None,
 ):
     """R-rank canonical exchange on ONE device (virtual ranks, vmapped).
 
@@ -142,7 +144,7 @@ def vrank_redistribute_fn(
         def pack_one(pos_v, count_v, me, *fields_v):
             iota = jnp.arange(n, dtype=jnp.int32)
             valid = iota < count_v
-            dest = binning.rank_of_position(pos_v, domain, grid)
+            dest = binning.rank_of_position(pos_v, domain, grid, edges=edges)
             dest = jnp.where(valid, dest, V).astype(jnp.int32)
             is_self = valid & (dest == me)
             dest_remote = jnp.where(is_self, V, dest)
@@ -195,6 +197,7 @@ def vrank_redistribute_planar_fn(
     capacity: int,
     out_capacity: int,
     ndim: int = None,
+    edges=None,
 ):
     """PLANAR canonical exchange: R virtual ranks on one device, ``[V, K, n]``.
 
@@ -255,7 +258,7 @@ def vrank_redistribute_planar_fn(
         def pack_one(fi_v, pos_v, count_v, me):
             iota = jnp.arange(n, dtype=jnp.int32)
             valid = iota < count_v
-            dest = binning.rank_of_position_planar(pos_v, domain, grid)
+            dest = binning.rank_of_position_planar(pos_v, domain, grid, edges=edges)
             dest = jnp.where(valid, dest, V).astype(jnp.int32)
             is_self = valid & (dest == me)
             dest_remote = jnp.where(is_self, V, dest)
@@ -316,6 +319,7 @@ def shard_redistribute_planar_fn(
     capacity: int,
     out_capacity: int,
     ndim: int = None,
+    edges=None,
 ):
     """PLANAR multi-device canonical exchange (runs under ``shard_map``).
 
@@ -368,7 +372,7 @@ def shard_redistribute_planar_fn(
         me = lax.axis_index(axes).astype(jnp.int32)
         iota = jnp.arange(n, dtype=jnp.int32)
         valid = iota < count[0]
-        dest = binning.rank_of_position_planar(pos_f, domain, grid)
+        dest = binning.rank_of_position_planar(pos_f, domain, grid, edges=edges)
         dest = jnp.where(valid, dest, R).astype(jnp.int32)
         # Self-owned columns stay local (never hit the wire); sentinel R
         # routes both invalid and self columns out of the remote pack.
@@ -418,6 +422,7 @@ def shard_redistribute_planar_sharded(
     capacity: int,
     out_capacity: int,
     ndim: int = None,
+    edges=None,
 ):
     """``shard_map``-wrapped (unjitted) planar exchange — composable under
     an outer jit (the public API fuses its field-bitcast boundary into the
@@ -433,7 +438,7 @@ def shard_redistribute_planar_sharded(
     spec_f = P(None, axes)
     spec_c = P(axes)
     fn = shard_redistribute_planar_fn(
-        domain, grid, capacity, out_capacity, ndim
+        domain, grid, capacity, out_capacity, ndim, edges=edges
     )
     out_specs = (
         spec_f,
@@ -455,11 +460,12 @@ def build_redistribute_planar(
     capacity: int,
     out_capacity: int,
     ndim: int = None,
+    edges=None,
 ):
     """jit of :func:`shard_redistribute_planar_sharded` (global planar)."""
     return jax.jit(
         shard_redistribute_planar_sharded(
-            mesh, domain, grid, capacity, out_capacity, ndim
+            mesh, domain, grid, capacity, out_capacity, ndim, edges=edges
         )
     )
 
@@ -471,11 +477,12 @@ def build_redistribute_planar_vranks(
     capacity: int,
     out_capacity: int,
     ndim: int = None,
+    edges=None,
 ):
     """jit of :func:`vrank_redistribute_planar_fn` ([V, K, n] planar)."""
     return jax.jit(
         vrank_redistribute_planar_fn(
-            domain, grid, capacity, out_capacity, ndim
+            domain, grid, capacity, out_capacity, ndim, edges=edges
         )
     )
 
@@ -486,9 +493,12 @@ def build_redistribute_vranks(
     grid: ProcessGrid,
     capacity: int,
     out_capacity: int,
+    edges=None,
 ):
     """jit of :func:`vrank_redistribute_fn` (single-device, [V, n, ...])."""
-    return jax.jit(vrank_redistribute_fn(domain, grid, capacity, out_capacity))
+    return jax.jit(
+        vrank_redistribute_fn(domain, grid, capacity, out_capacity, edges)
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -499,6 +509,7 @@ def build_redistribute(
     capacity: int,
     out_capacity: int,
     n_fields: int,
+    edges=None,
 ):
     """jit-compiled global redistribute over ``mesh``.
 
@@ -509,7 +520,7 @@ def build_redistribute(
     """
     axes = grid.axis_names
     spec = P(axes)
-    fn = shard_redistribute_fn(domain, grid, capacity, out_capacity)
+    fn = shard_redistribute_fn(domain, grid, capacity, out_capacity, edges)
     in_specs = (spec, spec) + (spec,) * n_fields
     out_specs = (
         (spec, spec)
