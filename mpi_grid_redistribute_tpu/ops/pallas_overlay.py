@@ -73,6 +73,56 @@ ROWS = 16  # plane rows per chunk: 2K halves + ones + targets <= ROWS
 ROWS_Q = 32  # quarter-plane variant: 4K bytes + ones + targets <= 32
 
 
+def _decode_targets(tgt_f32, base):
+    """Biased-f32 target patterns -> block-local int32 offsets.
+
+    Targets travel bitcast as ``int + 0x3F800000``: a raw int bitcast is
+    a DENORMAL f32 for targets < 2^23 and the TPU vector units flush
+    denormals to zero on any copy (measured: 1.28M corrupted targets of
+    58.7M on the first on-chip run); the bias keeps every pattern a
+    normal float for ints < 2^30. Shared by every kernel encoding so the
+    decode cannot drift between them."""
+    return (
+        jax.lax.bitcast_convert_type(tgt_f32, jnp.int32)
+        - jnp.int32(0x3F800000)
+        - base
+    )
+
+
+def _run_chunks(c0, c1, make_copies, body):
+    """DOUBLE-BUFFERED chunk loop shared by every kernel encoding.
+
+    The naive per-chunk start();wait() pair put a full HBM round-trip
+    latency on every chunk's critical path — at the 64M north-star
+    (thousands of blocks x ~2 chunks) that latency is the bulk of the
+    kernel's over-roofline per-block overhead. Chunk c+1's copies are in
+    flight while chunk c computes. ``make_copies(c, slot)`` returns the
+    async-copy descriptors for chunk ``c`` into buffer ``slot`` (equal
+    descriptors address the same semaphores, so start and wait may use
+    separately constructed instances); ``body(c, slot)`` consumes the
+    waited chunk."""
+
+    @pl.when(c0 < c1)
+    def _():
+        for cp in make_copies(c0, c0 % 2):
+            cp.start()
+
+    def chunk_body(c, carry):
+        slot = c % 2
+
+        @pl.when(c + 1 < c1)
+        def _():
+            for cp in make_copies(c + 1, 1 - slot):
+                cp.start()
+
+        for cp in make_copies(c, slot):
+            cp.wait()
+        body(c, slot)
+        return carry
+
+    jax.lax.fori_loop(c0, c1, chunk_body, None)
+
+
 def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
             acc, sems, *, k: int, w: int, rmax: int, rows: int,
             quarter: bool):
@@ -94,43 +144,21 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
     c0 = jax.lax.div(start, jnp.int32(rmax))
     c1 = jax.lax.div(end + jnp.int32(rmax - 1), jnp.int32(rmax))
 
-    # DOUBLE-BUFFERED chunk DMA: the per-chunk start();wait() pair put a
-    # full HBM round-trip latency on every chunk's critical path — at the
-    # 64M north-star (16k blocks x ~2 chunks) that latency is the bulk of
-    # the kernel's 15x-over-roofline per-block overhead. Chunk c+1's copy
-    # is now in flight while chunk c computes.
-    def dma(c, slot):
-        return pltpu.make_async_copy(
-            planes_hbm.at[:, pl.ds(c * rmax, rmax)],
-            planes_scr.at[slot],
-            sems.at[slot],
+    def copies(c, slot):
+        return (
+            pltpu.make_async_copy(
+                planes_hbm.at[:, pl.ds(c * rmax, rmax)],
+                planes_scr.at[slot],
+                sems.at[slot],
+            ),
         )
 
-    @pl.when(c0 < c1)
-    def _():
-        dma(c0, c0 % 2).start()
-
-    def chunk_body(c, _):
-        slot = c % 2
-
-        @pl.when(c + 1 < c1)
-        def _():
-            dma(c + 1, 1 - slot).start()
-
-        dma(c, slot).wait()
+    def chunk_compute(c, slot):
         chunk = planes_scr[slot]
-        # targets row -> sublane-major [RMAX, 1] for the lane compare;
-        # targets travel as bitcast (int + 0x3F800000) patterns: a raw
-        # int bitcast is a DENORMAL f32 for targets < 2^23 and the TPU
-        # vector units flush denormals to zero on any copy (measured:
-        # 1.28M corrupted targets of 58.7M at the first on-chip run);
-        # the bias keeps every pattern a normal float for ints < 2^30
+        # targets row -> sublane-major [RMAX, 1] for the lane compare
+        # (bias rationale: _decode_targets)
         tgt_scr[:] = chunk[rows - 1 : rows, :].T
-        tgt = (
-            jax.lax.bitcast_convert_type(tgt_scr[:], jnp.int32)
-            - jnp.int32(0x3F800000)
-            - base
-        )  # [RMAX, 1]
+        tgt = _decode_targets(tgt_scr[:], base)  # [RMAX, 1]
         # Dense one-hot compare + ONE matmul. A factored Kronecker form
         # (e_t = e_hi (x) e_lo, one masked [ROWS, rmax] @ [rmax, 128]
         # per 128-lane slice — 25x less one-hot VPU build) was measured
@@ -157,9 +185,8 @@ def _kernel(starts_ref, planes_hbm, in_ref, out_ref, planes_scr, tgt_scr,
                 else jax.lax.Precision.HIGHEST
             ),
         )
-        return _
 
-    jax.lax.fori_loop(c0, c1, chunk_body, None)
+    _run_chunks(c0, c1, copies, chunk_compute)
 
     # reassemble 32-bit words from the exact-integer planes
     if quarter:
@@ -220,6 +247,103 @@ def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX,
     )(starts, planes, flat)
 
 
+def _kernel_i8(starts_ref, planes_hbm, tgts_hbm, in_ref, out_ref,
+               planes_scr, tgtrow_scr, tgt_scr, acc, sems, tsems, *,
+               k: int, w: int, rmax: int, rows8: int):
+    """ALL-INTEGER overlay variant: payload bytes travel as (byte - 128)
+    int8 planes + a ones row, the one-hot is int8, and the per-chunk
+    matmul runs s8 x s8 -> s32 on the MXU (probed: lowers on this
+    chip). Exactness is integer arithmetic, no bf16-exactness argument
+    needed; the reassembly adds back ``128 * hit`` per byte plane.
+    Targets ride a separate f32 array (same +0x3F800000 bias — denormal
+    flush hazard) because the s8 plane stack cannot carry them."""
+    b = pl.program_id(0)
+    base = b * w
+    start = starts_ref[b]
+    end = starts_ref[b + 1]
+    acc[:] = jnp.zeros_like(acc)
+    c0 = jax.lax.div(start, jnp.int32(rmax))
+    c1 = jax.lax.div(end + jnp.int32(rmax - 1), jnp.int32(rmax))
+
+    def copies(c, slot):
+        return (
+            pltpu.make_async_copy(
+                planes_hbm.at[:, pl.ds(c * rmax, rmax)],
+                planes_scr.at[slot],
+                sems.at[slot],
+            ),
+            pltpu.make_async_copy(
+                tgts_hbm.at[:, pl.ds(c * rmax, rmax)],
+                tgtrow_scr.at[slot],
+                tsems.at[slot],
+            ),
+        )
+
+    def chunk_compute(c, slot):
+        chunk = planes_scr[slot]  # [rows8, rmax] s8
+        tgt_scr[:] = tgtrow_scr[slot].T  # [rmax, 1] f32
+        tgt = _decode_targets(tgt_scr[:], base)
+        onehot = (
+            tgt == jax.lax.broadcasted_iota(jnp.int32, (rmax, w), 1)
+        ).astype(jnp.int8)
+        acc[:] += jax.lax.dot_general(
+            chunk, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    _run_chunks(c0, c1, copies, chunk_compute)
+
+    hit_cnt = acc[4 * k : 4 * k + 1, :]  # ones-row matmul: 0 or 1
+    off = hit_cnt * jnp.int32(128)  # add back the -128 bias on hits
+    b0 = acc[0:k, :] + off
+    b1 = acc[k : 2 * k, :] + off
+    b2 = acc[2 * k : 3 * k, :] + off
+    b3 = acc[3 * k : 4 * k, :] + off
+    words = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+    if in_ref.dtype != jnp.int32:
+        words = jax.lax.bitcast_convert_type(words, in_ref.dtype)
+    out_ref[:] = jnp.where(hit_cnt > 0, words[0 : in_ref.shape[0], :],
+                           in_ref[:])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "w", "rmax")
+)
+def _overlay_sorted_i8(flat, starts, planes8, tgts, interpret=False, w=W,
+                       rmax=RMAX):
+    k, m = flat.shape
+    rows8 = planes8.shape[0]
+    kernel = functools.partial(
+        _kernel_i8, k=k, w=w, rmax=rmax, rows8=rows8
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(m // w,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # starts [T+1]
+            pl.BlockSpec(memory_space=pl.ANY),  # planes8 [rows8, P_pad]
+            pl.BlockSpec(memory_space=pl.ANY),  # tgts [1, P_pad] f32
+            pl.BlockSpec((k, w), lambda b: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, w), lambda b: (0, b),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (k, m), flat.dtype, vma=jax.typeof(flat).vma
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows8, rmax), jnp.int8),  # 2 chunk buffers
+            pltpu.VMEM((2, 1, rmax), jnp.float32),  # 2 target rows
+            pltpu.VMEM((rmax, 1), jnp.float32),  # transposed targets
+            pltpu.VMEM((rows8, w), jnp.int32),  # accumulator
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(starts, planes8, tgts, flat)
+
+
 def _raise_on_duplicate_targets(dup) -> None:
     dup = int(dup)
     if dup > 0:
@@ -238,8 +362,9 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     """Drop-in for ``flat.at[:, targets].set(cols, mode='drop')``.
 
     ``flat`` f32 or int32 ``[K, m]`` (int32 is the migrate engines' round-4
-    bit-pattern-safe transport; the kernel's half-plane encoding is
-    dtype-agnostic — only the final reassembly bitcast differs);
+    bit-pattern-safe transport; every encoding's exact-integer plane
+    split is dtype-agnostic — only the final reassembly bitcast
+    differs);
     ``targets`` int32 ``[P]`` unique among in-range entries (>= m drops);
     ``cols`` ``[K, P]`` matching ``flat``. Falls back to the XLA scatter
     when the kernel contract doesn't hold (see module docstring).
@@ -256,23 +381,28 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     ``"half"`` — 2K uint16 rows, matmul at HIGHEST (uint16 is not
     bf16-exact: 6 bf16 passes); ``"quarter"`` — 4K byte rows, matmul at
     DEFAULT (bytes <= 255 ARE bf16-exact, so the single pass is exact
-    for one-hot products). Default: env ``MPI_GRID_OVERLAY_ENC`` or
-    "quarter" (on-chip A/B: see BENCH_CONFIGS.md). Both are bit-exact.
+    for one-hot products); ``"int8"`` — 4K (byte - 128) s8 rows and an
+    s8 one-hot, s8 x s8 -> s32 on the MXU (all-integer exactness, 4x
+    less one-hot VMEM traffic). Default: env ``MPI_GRID_OVERLAY_ENC``
+    or "int8" (paired on-chip A/B at the 64M landing, W=8192: int8
+    34.1 ms vs quarter 46.3 — the s8 one-hot's 4x smaller VMEM
+    footprint and the s32 MXU path win at scale; headline-shape tie at
+    3.89 vs 3.93. See BENCH_CONFIGS.md). All bit-exact.
     """
     k, m = flat.shape
     p = targets.shape[0]
     if encoding is None:
-        encoding = os.environ.get("MPI_GRID_OVERLAY_ENC", "quarter")
-    if encoding not in ("half", "quarter"):
+        encoding = os.environ.get("MPI_GRID_OVERLAY_ENC", "int8")
+    if encoding not in ("half", "quarter", "int8"):
         # a typo'd env var silently running the slower engine would be a
         # miserable perf hunt — fail loudly instead
         raise ValueError(
-            f"overlay encoding must be 'half' or 'quarter', got "
+            f"overlay encoding must be 'half', 'quarter' or 'int8', got "
             f"{encoding!r} (check MPI_GRID_OVERLAY_ENC)"
         )
     quarter = encoding == "quarter"
-    rows_needed = (4 * k + 2) if quarter else (2 * k + 2)
-    rows_total = ROWS_Q if quarter else ROWS
+    rows_needed = (2 * k + 2) if encoding == "half" else (4 * k + 2)
+    rows_total = ROWS if encoding == "half" else ROWS_Q
     if debug_unique is None:
         debug_unique = os.environ.get("MPI_GRID_OVERLAY_DEBUG") == "1"
     if debug_unique and p > 1:
@@ -331,16 +461,6 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     words = jax.lax.bitcast_convert_type(
         jnp.stack(s[1:], axis=0), jnp.uint32
     )
-    if quarter:
-        payload_rows = [
-            ((words >> (8 * i)) & 0xFF).astype(jnp.float32)  # <= 255
-            for i in range(4)
-        ]
-    else:
-        payload_rows = [
-            (words >> 16).astype(jnp.float32),  # exact: <= 65535
-            (words & 0xFFFF).astype(jnp.float32),
-        ]
     p_pad = max(-(-p // rmax) * rmax, rmax)
     pad = p_pad - p
 
@@ -352,29 +472,65 @@ def overlay_scatter_planar(flat, targets, cols, interpret=False, w=None,
     bias = jnp.int32(0x3F800000)
     ts_bits = jax.lax.bitcast_convert_type(ts + bias, jnp.float32)
     sent_bits = jax.lax.bitcast_convert_type(sentinel + bias, jnp.float32)
+    # per-block starts — shared by every encoding: scatter-free dense
+    # searchsorted (m < 2^30 is already guarded, so the ×2 code fits
+    # int32); jnp's method="sort" pays a P-length rank scatter — measured
+    # as a visible slice of the in-context landing. match_vma: under
+    # shard_map every pallas_call input must carry the same varying mesh
+    # axes or tracing inserts a `pvary` INSIDE the kernel jaxpr, which
+    # the Mosaic TPU lowering rejects.
+    starts = binning.match_vma(
+        binning.bounds_dense(ts, m // w + 1, stride=w, key_bound=m), flat
+    )
+    # padded biased-target row, shared by every encoding's plane build
+    tgt_row = jnp.concatenate(
+        [ts_bits, jnp.full((pad,), sent_bits, jnp.float32)]
+    )[None, :]
+    if encoding == "int8":
+        # (byte - 128) fits s8 exactly; the kernel adds 128*hit back
+        payload8 = [
+            (((words >> (8 * i)) & 0xFF).astype(jnp.int32) - 128).astype(
+                jnp.int8
+            )
+            for i in range(4)
+        ]
+        rows8 = 4 * k + 1
+        rows8_pad = -(-rows8 // 8) * 8  # s8 HBM slices need 8-sublane
+        #                                 alignment (Mosaic tiling (8,128))
+        planes8 = jnp.concatenate(
+            [
+                *[padk(r, 0) for r in payload8],
+                padk(jnp.ones((1, p), jnp.int8), 0),  # hit-count row
+                jnp.zeros((rows8_pad - rows8, p_pad), jnp.int8),
+            ],
+            axis=0,
+        )
+        planes8 = binning.match_vma(planes8, flat)
+        tgts = binning.match_vma(tgt_row, flat)
+        return _overlay_sorted_i8(
+            flat, starts, planes8, tgts, interpret=interpret, w=w,
+            rmax=rmax,
+        )
+    if quarter:
+        payload_rows = [
+            ((words >> (8 * i)) & 0xFF).astype(jnp.float32)  # <= 255
+            for i in range(4)
+        ]
+    else:
+        payload_rows = [
+            (words >> 16).astype(jnp.float32),  # exact: <= 65535
+            (words & 0xFFFF).astype(jnp.float32),
+        ]
     planes = jnp.concatenate(
         [
             *[padk(r, 0.0) for r in payload_rows],
             padk(jnp.ones((1, p), jnp.float32), 0.0),  # hit-count row
             jnp.zeros((rows_total - rows_needed, p_pad), jnp.float32),
             # targets row, LAST (the kernel reads rows-1)
-            jnp.concatenate(
-                [ts_bits, jnp.full((pad,), sent_bits, jnp.float32)]
-            )[None, :],
+            tgt_row,
         ],
         axis=0,
     )
-    # scatter-free dense searchsorted (m < 2^30 is already guarded, so
-    # the ×2 code fits int32); jnp's method="sort" pays a P-length rank
-    # scatter — measured as a visible slice of the in-context landing
-    starts = binning.bounds_dense(
-        ts, m // w + 1, stride=w, key_bound=m
-    )
-    # under shard_map every pallas_call input must carry the same varying
-    # mesh axes or tracing inserts a `pvary` INSIDE the kernel jaxpr,
-    # which the Mosaic TPU lowering rejects; promote the scalar-prep
-    # arrays to the state's vma explicitly
-    starts = binning.match_vma(starts, flat)
     planes = binning.match_vma(planes, flat)
     return _overlay_sorted(
         flat, starts, planes, interpret=interpret, w=w, rmax=rmax,

@@ -20,23 +20,37 @@ def _ref(flat, targets, cols):
 
 
 @pytest.mark.parametrize("seed", [0, 1])
-@pytest.mark.parametrize("encoding", ["quarter", "half"])
-def test_overlay_matches_xla_scatter_bits(rng, seed, encoding, _devices):
-    # both the shipped quarter (byte planes, DEFAULT matmul) and the
-    # fallback half (uint16 planes, HIGHEST) encodings must be bit-exact
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+@pytest.mark.parametrize("encoding", ["quarter", "half", "int8"])
+def test_overlay_matches_xla_scatter_bits(rng, seed, encoding, dtype,
+                                          _devices):
+    # every encoding must be bit-exact: int8 ((byte-128) s8 planes,
+    # s8xs8->s32 matmul) is the SHIPPED default; quarter (byte planes,
+    # DEFAULT matmul) and half (uint16 planes, HIGHEST) stay selectable.
+    # Both dtypes matter: production migrate hands the kernel int32
+    # bit-pattern transport, tests historically only drove f32.
     r = np.random.default_rng(seed)
     k, m, p = 7, 4 * 256, 37
     w, rmax = 256, 128
-    flat = r.standard_normal((k, m)).astype(np.float32)
     targets = r.choice(m, size=p, replace=False).astype(np.int32)
-    cols = r.standard_normal((k, p)).astype(np.float32)
-    # bitcast int32 payloads (NaN-looking bit patterns) in one row
-    cols[3] = r.integers(-(2**31), 2**31 - 1, size=p, dtype=np.int32).view(
-        np.float32
-    )
-    flat[3] = r.integers(-(2**31), 2**31 - 1, size=m, dtype=np.int32).view(
-        np.float32
-    )
+    if dtype is np.int32:
+        # the migrate engines' transport: raw int32 words, cols matching
+        flat = r.integers(
+            -(2**31), 2**31 - 1, size=(k, m), dtype=np.int32
+        )
+        cols = r.integers(
+            -(2**31), 2**31 - 1, size=(k, p), dtype=np.int32
+        )
+    else:
+        flat = r.standard_normal((k, m)).astype(np.float32)
+        cols = r.standard_normal((k, p)).astype(np.float32)
+        # bitcast int32 payloads (NaN-looking bit patterns) in one row
+        cols[3] = r.integers(
+            -(2**31), 2**31 - 1, size=p, dtype=np.int32
+        ).view(np.float32)
+        flat[3] = r.integers(
+            -(2**31), 2**31 - 1, size=m, dtype=np.int32
+        ).view(np.float32)
     out = pallas_overlay.overlay_scatter_planar(
         jnp.asarray(flat), jnp.asarray(targets), jnp.asarray(cols),
         interpret=True, w=w, rmax=rmax, encoding=encoding,
