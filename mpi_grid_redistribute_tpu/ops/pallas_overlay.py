@@ -22,30 +22,33 @@ entirely:
 
 BIT-EXACTNESS. The fused payload carries arbitrary 32-bit patterns
 (bitcast int fields routinely look like NaNs), and ``NaN * 0.0 = NaN``
-would poison a float matmul. The kernel therefore matmuls on uint16
-HALF-PLANES encoded as f32: each payload word contributes two rows
-(``hi16``, ``lo16`` as exact f32 integers <= 65535); one-hot products and
-single-term sums of such values are exact in f32 (HIGHEST precision), and
-the kernel reassembles ``(hi << 16) | lo`` in int32 before bitcasting
-back. Targets ride the same plane stack bitcast as ``int + 0x3F800000``
-— a raw int bitcast is a denormal f32 below 2^23 and TPU vector copies
-flush denormals to zero (measured); the bias keeps every pattern a
-normal float for any ``m < 2^30`` — and a ones row yields the hit mask.
+would poison a float matmul — so every encoding splits payload words
+into EXACT-INTEGER planes and reassembles after the matmul. Shipped
+default (late round 4): ``int8`` — four ``(byte - 128)`` s8 rows + a
+ones row, s8 one-hot, s8 x s8 -> s32 on the MXU (integer arithmetic end
+to end; the reassembly adds ``128 * hit`` back per byte plane).
+Selectable alternatives: ``quarter`` (4 byte rows as f32, DEFAULT
+precision — bytes <= 255 are bf16-exact) and ``half`` (2 uint16 rows as
+f32, HIGHEST — uint16 is not bf16-exact: 6 passes). Targets ride
+bitcast as ``int + 0x3F800000`` — a raw int bitcast is a denormal f32
+below 2^23 and TPU vector copies flush denormals to zero (measured);
+the bias keeps every pattern a normal float for any ``m < 2^30`` — and
+the ones row yields the hit mask.
 
-MEASURED (v5e-class chip, 8.4M-column planar state, 196k updates —
-scripts/microbench_overlay.py): XLA column scatter 17.6 ms; this kernel
-3.93 ms end-to-end including the XLA-side payload sort and plane prep
-(4.4x — round 4: double-buffered chunk DMA, W=4096, in/out aliasing; the
-round-3 single-buffered form was 6.7 ms/2.6x). At the 64M north-star:
-73.1 vs 132.6 ms. In the migrate step the landing phase drove the
-headline from 44.3 (round 2, XLA scatter) to 24.8 ms; see
-BENCH_CONFIGS.md.
+MEASURED (v5e-class chip — scripts/microbench_overlay{,_ns}.py,
+BENCH_CONFIGS.md): 8.4M-column landing, 196k updates: XLA column
+scatter 17.6 ms vs 3.9 ms end-to-end (sort + plane prep included). 64M
+north-star landing, 1.57M updates, W=8192: XLA 132.6 ms; quarter 46.3;
+int8 34.1 (paired same-process A/B). In the migrate step the landing
+phase drove the headline from 44.3 ms/step (round 2, XLA scatter) to
+the round-4 endgame's ~12.7; see BENCH_CONFIGS.md.
 
-Contract: ``flat`` f32 planar ``[K, m]`` with ``2 * K + 2 <= ROWS``
-(i.e. K <= 7 at ROWS = 16: pos 3 + vel 3 + alive), ``m`` a multiple of
-``W``; targets int32, UNIQUE among in-range entries
-(out-of-range = drop sentinel, matching ``mode='drop'``); ``cols`` f32
-``[K, P]``. Falls back to the XLA scatter otherwise.
+Contract: ``flat`` f32 or int32 planar ``[K, m]`` with
+``4 * K + 2 <= ROWS_Q`` (K <= 7: pos 3 + vel 3 + alive), ``m`` a
+multiple of the selected block width; targets int32, UNIQUE among
+in-range entries (out-of-range = drop sentinel, matching
+``mode='drop'``); ``cols`` matching ``flat``. Falls back to the XLA
+scatter otherwise.
 """
 
 from __future__ import annotations
