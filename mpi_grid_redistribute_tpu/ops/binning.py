@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -275,6 +276,114 @@ def sorted_dest_counts(dest, n_dest: int):
             side="left",
         ).astype(jnp.int32)
     return order, bounds[1:] - bounds[:-1], bounds
+
+
+def sorted_dest_counts_batched(dest, n_dest: int, *, chunk: int = 4096,
+                               cap: int = 512):
+    """Batched :func:`sorted_dest_counts` over ``[V, n]`` key rows, with a
+    TWO-LEVEL leaver selection fast path.
+
+    The migrate engines consume the destination sort ONLY on the leaver
+    prefix: stayers carry the sentinel key ``n_dest`` and sort to the
+    tail, and every downstream read sits inside a leaver segment (clipped
+    and masked by granted counts). A full ``[V, n]`` packed sort is the
+    single largest phase of the 64-vrank north-star knockout (~55 ms at
+    64x1M, BENCH_CONFIGS.md) — but ``lax.sort``'s per-element cost falls
+    with column width (measured 0.49 ns/elem at 4K columns vs 1.68 at 1M,
+    ``scripts/microbench_select.py``), so sorting small CHUNKS, keeping
+    each chunk's bounded leaver prefix, and finishing with one small sort
+    over the candidates reproduces the consumed prefix bit-for-bit at a
+    fraction of the moved bytes: 56.3 -> 23.6 ms at 64x1M, 2% leavers.
+
+    Exactness: within a chunk the packed ``(dest << bT) | iota_t`` sort
+    orders entries by (dest, global position) — iota_t order IS global
+    order within the chunk — and the sentinel sorts past every real
+    destination, so chunk ``c``'s leavers are exactly its first ``lc[c]``
+    sorted entries. When every ``lc[c] <= cap`` (the GUARD), the sliced
+    candidates contain all leavers; repacking them as
+    ``(dest << bits(n)) | global_pos`` and sorting once more yields the
+    exact stable (dest, position) order the flat packed sort produces.
+    Counts and bounds read off the small sorted array are exact. The
+    ``order`` tail beyond the leavers is ZEROS (never read — every
+    consumer masks at granted counts <= leavers); a ``lax.cond`` routes
+    guard-violating steps (a chunk with > ``cap`` leavers) to the flat
+    sort, so correctness never depends on the density assumption. The
+    guard is ONE scalar across all rows: a per-row (vmapped) cond would
+    lower to a select and execute both branches.
+
+    Args:
+      dest: [V, n] int32 destinations; sentinel ``n_dest`` marks rows to
+        exclude (not counted, sorted to the tail).
+      n_dest: number of destinations.
+      chunk: power-of-two chunk width for the first-level sorts.
+      cap: per-chunk leaver candidate budget (guard threshold).
+
+    Returns:
+      (order [V, n], counts [V, n_dest], bounds [V, n_dest + 1]) — the
+      leaver prefix of each ``order`` row, the counts, and the bounds are
+      bit-identical to ``vmap(sorted_dest_counts)``.
+    """
+    V, n = dest.shape
+
+    def flat():
+        o, c, b = jax.vmap(lambda k: sorted_dest_counts(k, n_dest))(dest)
+        return o, c, b
+
+    bN = max(1, (n - 1).bit_length())
+    bT = (chunk - 1).bit_length()
+    nc = -(-n // chunk)
+    if (
+        chunk & (chunk - 1)
+        or n_dest + 1 > (1 << (31 - bN))  # second-level packing overflow
+        or n_dest + 1 > (1 << (31 - bT))  # first-level packing overflow
+        or nc * cap >= n  # selection would not shrink the problem
+        # TRACE-TIME A/B hook (like MPI_GRID_VACATED_PLAN): consulted
+        # when the caller's jit first traces — toggling it later in the
+        # same process is ignored by the cached executable.
+        or os.environ.get("MPI_GRID_SELECT") == "flat"
+    ):
+        return flat()
+    npad = nc * chunk - n
+    ch = dest
+    if npad:
+        ch = jnp.concatenate(
+            [dest, jnp.full((V, npad), n_dest, jnp.int32)], axis=1
+        )
+    ch = ch.reshape(V, nc, chunk)
+    lc = jnp.sum((ch != n_dest).astype(jnp.int32), axis=-1)  # [V, nc]
+    ok = jnp.max(lc) <= cap
+
+    def two_level():
+        iota_t = jnp.arange(chunk, dtype=jnp.int32)
+        packed1 = jax.lax.sort(
+            (ch << bT) | iota_t, dimension=-1, is_stable=False
+        )
+        cand = jax.lax.slice_in_dim(packed1, 0, cap, axis=2)
+        dest_c = cand >> bT
+        pos_g = (
+            jnp.arange(nc, dtype=jnp.int32)[None, :, None] * chunk
+        ) | (cand & (chunk - 1))
+        live = (
+            jnp.arange(cap, dtype=jnp.int32)[None, None, :]
+            < lc[:, :, None]
+        )
+        packed2 = jnp.where(
+            live, (dest_c << bN) | pos_g, jnp.int32(n_dest << bN)
+        )
+        packed2 = jax.lax.sort(
+            packed2.reshape(V, nc * cap), dimension=-1, is_stable=False
+        )
+        order_c = packed2 & jnp.int32((1 << bN) - 1)
+        edges = jnp.arange(n_dest + 1, dtype=jnp.int32) << bN
+        bounds = jax.vmap(
+            lambda p: jnp.searchsorted(p, edges, side="left")
+        )(packed2).astype(jnp.int32)
+        order = jax.lax.dynamic_update_slice(
+            jnp.zeros((V, n), jnp.int32), order_c, (0, 0)
+        )
+        return order, bounds[:, 1:] - bounds[:, :-1], bounds
+
+    return jax.lax.cond(ok, two_level, flat)
 
 
 def bounds_dense(keys_sorted, n_edges: int, stride: int = 1,

@@ -1,0 +1,87 @@
+"""Decompose the two-level selection: is lax.sort data-dependent, does
+the cond fallback run both branches, what does each stage cost?
+
+Usage: python scripts/microbench_select2.py
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.utils import profiling
+
+V, n, R = 64, 1 << 20, 64
+rng = np.random.default_rng(0)
+dest_np = np.full((V, n), R, np.int32)
+mask = rng.random((V, n)) < 0.02
+dest_np[mask] = rng.integers(0, R, size=int(mask.sum()), dtype=np.int32)
+dest0 = jnp.asarray(dest_np)
+iota = jnp.arange(n, dtype=jnp.int32)
+rand0 = jnp.asarray(
+    rng.integers(0, 1 << 27, size=(V, n), dtype=np.int32)
+)
+
+
+def bench(name, fn, x):
+    def make_loop(S):
+        @jax.jit
+        def loop(d):
+            def body(c, _):
+                o = fn(c).reshape(c.shape)
+                return c ^ (o & 1).astype(jnp.int32), ()
+            c, _ = lax.scan(body, d, None, length=S)
+            return c
+        return loop
+
+    per, _, _ = profiling.scan_time_per_step(make_loop, (x,), s1=4, s2=16)
+    print(f"{name:46s} {per*1e3:8.2f} ms", flush=True)
+    return per
+
+
+b = 20
+bench("flat packed sort, skewed engine keys",
+      lambda d: lax.sort((d << b) | iota, dimension=-1, is_stable=False),
+      dest0)
+bench("flat packed sort, random keys",
+      lambda d: lax.sort(d, dimension=-1, is_stable=False), rand0)
+
+T, q = 4096, 512
+nc = n // T
+bT = (T - 1).bit_length()
+iota_t = jnp.arange(T, dtype=jnp.int32)
+
+
+def chunk_sort(d):
+    ch = d.reshape(V, nc, T)
+    return lax.sort((ch << bT) | iota_t, dimension=-1, is_stable=False)
+
+
+bench("chunk sort [64,256,4096], skewed", chunk_sort, dest0)
+bench("chunk sort [64,256,4096], random",
+      lambda d: lax.sort(d.reshape(V, nc, T), dimension=-1,
+                         is_stable=False), rand0)
+
+
+def two_level_nocond(d):
+    bN = (n - 1).bit_length()
+    ch = d.reshape(V, nc, T)
+    lc = jnp.sum((ch != R).astype(jnp.int32), axis=-1)
+    packed1 = lax.sort((ch << bT) | iota_t, dimension=-1, is_stable=False)
+    cand = lax.slice_in_dim(packed1, 0, q, axis=2)
+    dest_c = cand >> bT
+    pos_g = (jnp.arange(nc, dtype=jnp.int32)[None, :, None] * T) | (
+        cand & (T - 1)
+    )
+    live = jnp.arange(q, dtype=jnp.int32)[None, None, :] < lc[:, :, None]
+    packed2 = jnp.where(live, (dest_c << bN) | pos_g, (R << bN))
+    packed2 = lax.sort(
+        packed2.reshape(V, nc * q), dimension=-1, is_stable=False
+    )
+    order_c = packed2 & ((1 << bN) - 1)
+    pad = jnp.zeros((V, n), jnp.int32)
+    return lax.dynamic_update_slice(pad, order_c, (0, 0))
+
+
+bench("two-level fast path only (no cond)", two_level_nocond, dest0)

@@ -210,3 +210,59 @@ def test_bounds_dense_matches_searchsorted():
         keys, np.arange(100, dtype=np.int64) * 2**24, side="left"
     ).astype(np.int32)
     np.testing.assert_array_equal(got, want)
+
+
+def _select_ref(dest, n_dest):
+    import jax
+
+    return jax.vmap(
+        lambda k: binning.sorted_dest_counts(k, n_dest)
+    )(jnp.asarray(dest))
+
+
+@pytest.mark.parametrize(
+    "n,chunk,cap,frac",
+    [
+        (8192, 512, 64, 0.02),   # fast path, several chunks
+        (8192, 512, 64, 0.5),    # guard violated -> cond fallback
+        (5000, 512, 64, 0.02),   # n not a chunk multiple (padding)
+        (300, 512, 64, 0.1),     # n < chunk (single padded chunk)
+        (4096, 512, 8, 0.05),    # tight cap: fallback on unlucky chunks
+    ],
+)
+def test_sorted_dest_counts_batched_matches_flat(rng, n, chunk, cap, frac):
+    V, R = 5, 23
+    dest = np.full((V, n), R, np.int32)
+    m = rng.random((V, n)) < frac
+    dest[m] = rng.integers(0, R, size=int(m.sum()), dtype=np.int32)
+    o2, c2, b2 = binning.sorted_dest_counts_batched(
+        jnp.asarray(dest), R, chunk=chunk, cap=cap
+    )
+    o1, c1, b1 = _select_ref(dest, R)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
+    # the consumed contract: the leaver prefix is bit-identical
+    nl = np.asarray(c1).sum(axis=1)
+    for v in range(V):
+        np.testing.assert_array_equal(
+            np.asarray(o1)[v, : nl[v]], np.asarray(o2)[v, : nl[v]]
+        )
+
+
+def test_sorted_dest_counts_batched_static_fallbacks(rng, monkeypatch):
+    V, n, R = 3, 1024, 7
+    dest = np.full((V, n), R, np.int32)
+    dest[:, ::97] = 3
+    want = [np.asarray(a) for a in _select_ref(dest, R)]
+    # env escape hatch forces the flat engine (A/B hook)
+    monkeypatch.setenv("MPI_GRID_SELECT", "flat")
+    got = binning.sorted_dest_counts_batched(jnp.asarray(dest), R)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, np.asarray(g))
+    monkeypatch.delenv("MPI_GRID_SELECT")
+    # non-power-of-two chunk: static flat fallback, full equality
+    got = binning.sorted_dest_counts_batched(
+        jnp.asarray(dest), R, chunk=500, cap=50
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, np.asarray(g))
