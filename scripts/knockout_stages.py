@@ -133,10 +133,10 @@ def truncated_step(domain, vgrid, C, M, n, phase):
             if phase == 1:
                 return dep_out(dest_key)
 
-        # ---- 2: stable key sort + counts --------------------------------
-        order, counts, bounds = jax.vmap(
-            lambda k: binning.sorted_dest_counts(k, R_total)
-        )(dest_key)
+        # ---- 2: two-level leaver selection (sort + counts) --------------
+        order, counts, bounds = binning.sorted_dest_counts_batched(
+            dest_key, R_total
+        )
         if phase == 2:
             return dep_out(order, counts, bounds)
 
