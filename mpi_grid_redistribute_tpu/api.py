@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -336,6 +337,9 @@ class GridRedistribute:
         self._cum_counters = None
         self._seen_send = 0
         self._seen_recv = 0
+        self._resolved_through = 0  # call index covered by the last
+        # successfully-read counter snapshot (clean OR lossy)
+        self._del_warned = False  # __del__ warns at most once
         self._last_caps = None  # (cap, out_cap, n_local) of the last call
         self.capacity = capacity
         self.capacity_factor = float(capacity_factor)
@@ -588,16 +592,39 @@ class GridRedistribute:
         self, dropped_send, dropped_recv, needed, needed_out, n_local,
         cap, out_cap,
     ) -> bool:
-        """Raise the instance capacities from measured need; True if grown."""
+        """Raise the instance capacities from measured need; True if grown.
+
+        Growth compares against the CURRENT instance capacities, not just
+        the ``cap``/``out_cap`` in force at the measured call: a late
+        flush resolving a stale window must never shrink a capacity grown
+        in the interim."""
         grew = False
+        # Growth triggers when the measured WINDOW needed more than the
+        # caps it ran with, but the assigned value keeps a never-shrink
+        # floor: the current explicit capacity, or — in derived mode
+        # (self.capacity is None) — the caps of the most recent call, so
+        # a late flush of a stale small-workload window cannot pin an
+        # explicit capacity below what the current workload derives.
+        last_cap, last_out = (
+            (self._last_caps[0], self._last_caps[1])
+            if self._last_caps is not None
+            else (0, 0)
+        )
         if dropped_send:
             new_cap = min(_next_pow2(needed), n_local)
             if new_cap > cap:
-                self.capacity, grew = new_cap, True
+                floor = last_cap if self.capacity is None else self.capacity
+                self.capacity = max(new_cap, floor)
+                grew = True
         if dropped_recv:
             new_out = min(_next_pow2(needed_out), self.nranks * n_local)
             if new_out > out_cap:
-                self.out_capacity, grew = new_out, True
+                floor = (
+                    last_out if self.out_capacity is None
+                    else self.out_capacity
+                )
+                self.out_capacity = max(new_out, floor)
+                grew = True
         return grew
 
     def _deferred_check(self, n_local, cap, out_cap) -> None:
@@ -625,6 +652,7 @@ class GridRedistribute:
             return
         counters, cap, out_cap, n_local, call_idx = self._pending_check
         self._pending_check = None
+        self._resolved_through = max(self._resolved_through, call_idx)
         total_send = int(np.asarray(counters["dropped_send"]))
         total_recv = int(np.asarray(counters["dropped_recv"]))
         dropped_send = total_send - self._seen_send
@@ -650,6 +678,72 @@ class GridRedistribute:
             f"check_every (or on_overflow='ignore' + your own per-step "
             f"check) to narrow the window."
         )
+
+    def _has_unresolved_windows(self) -> bool:
+        """True when deferred-mode calls exist whose cumulative counters
+        have not been read back yet — a scheduled-but-unresolved snapshot,
+        a trailing partial window, or the tail left when a scheduled
+        resolution raised (its RuntimeError accounts only through its own
+        snapshot; later calls' counters were folded in but never read)."""
+        return (
+            self._cum_counters is not None
+            and self._call_index > self._resolved_through
+        )
+
+    def __enter__(self) -> "GridRedistribute":
+        """Context-manager form: ``with GridRedistribute(...) as rd`` —
+        ``__exit__`` runs :meth:`flush_overflow_checks`, so a lossy
+        trailing window under ``on_overflow='grow'`` raises at block exit
+        instead of being silently forgotten (the one human gap the
+        deferred-check design left open)."""
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self.flush_overflow_checks()
+        else:
+            # An exception is already propagating: still resolve (so
+            # growth happens and the loss is surfaced), but as a warning —
+            # raising here would mask the in-flight exception. Catch ANY
+            # flush failure (the blocking device read can raise
+            # backend-specific errors that are not RuntimeError), and
+            # force the warning to PRINT rather than raise even under
+            # warnings-as-errors: an escaping RuntimeWarning would itself
+            # mask the in-flight exception.
+            try:
+                self.flush_overflow_checks()
+            except Exception as loss:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("always")
+                    warnings.warn(
+                        f"flush_overflow_checks at context exit: {loss!r}",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+        return False
+
+    def __del__(self):
+        # Unflushed deferred windows at garbage collection: the user built
+        # a 'grow' instance, ran calls whose overflow counters were never
+        # read, and dropped it without flush_overflow_checks() / `with`.
+        # We cannot raise from __del__, so warn loudly (SURVEY.md §5.3:
+        # surfaced, not silent).
+        try:
+            unresolved = self._has_unresolved_windows() and not self._del_warned
+        except Exception:
+            return  # partially-constructed instance
+        if unresolved:
+            self._del_warned = True  # idempotent: explicit __del__ then GC
+            warnings.warn(
+                "GridRedistribute dropped with unresolved deferred "
+                "overflow windows: call flush_overflow_checks() at loop "
+                "end (or use the instance as a context manager: "
+                "`with GridRedistribute(...) as rd:`) — a capacity "
+                "overflow in the trailing window would otherwise go "
+                "unreported",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def flush_overflow_checks(self) -> None:
         """Resolve the FULL cumulative counter history (blocking),

@@ -318,10 +318,30 @@ def sorted_dest_counts_batched(dest, n_dest: int, *, chunk: int = 4096,
       chunk: power-of-two chunk width for the first-level sorts.
       cap: per-chunk leaver candidate budget (guard threshold).
 
+    Dense-step cost: the ``lax.cond`` fallback traces the full ``[V, n]``
+    flat packed sort alongside the two-level graph, so a guard-violating
+    step (dense migration — some chunk has > ``cap`` leavers) pays the
+    chunk sorts and ``lc`` reduction *and then* the flat sort, and the
+    cond's branch buffers can raise peak memory at 64×1M-class shapes.
+    This matches the slab-guard pattern elsewhere in the repo: steady
+    sparse steps get the fast path; operators should expect a transient
+    regression (not an error) when migration bursts exceed ``cap`` per
+    chunk.
+
     Returns:
-      (order [V, n], counts [V, n_dest], bounds [V, n_dest + 1]) — the
-      leaver prefix of each ``order`` row, the counts, and the bounds are
-      bit-identical to ``vmap(sorted_dest_counts)``.
+      (order_prefix [V, n], counts [V, n_dest], bounds [V, n_dest + 1]) —
+      the leaver prefix of each ``order_prefix`` row, the counts, and the
+      bounds are bit-identical to ``vmap(sorted_dest_counts)``.
+
+      ``order_prefix`` is NOT a full permutation: only the first
+      ``counts[v].sum()`` entries of row ``v`` (the leaver prefix) are
+      contractual. On the two-level fast path the tail is zero-filled —
+      in-range but junk (each gathered tail entry silently reads element
+      0 of its row); on the flat fallback (static conditions above, or a
+      guard-violating dense step) the tail happens to be the real
+      sentinel-sorted suffix. Consumers MUST NOT rely on either: mask or
+      slice at granted/leaver counts (all in-repo callers do). The name
+      records the prefix-only contract at call sites.
     """
     V, n = dest.shape
 

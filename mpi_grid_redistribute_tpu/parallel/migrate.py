@@ -578,11 +578,12 @@ def shard_migrate_fused_fn(
         dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
 
         # two-level leaver selection; the [1, n] batch shape reuses the
-        # vrank engine's machinery (scalar-guard cond, see binning)
-        order, full_counts, bounds = (
-            a[0]
-            for a in binning.sorted_dest_counts_batched(dest_key[None], R)
-        )
+        # vrank engine's machinery (scalar-guard cond, see binning).
+        # order is prefix-only: valid through the leaver count, zero tail
+        # (see sorted_dest_counts_batched) — every read below is masked
+        # or sliced at granted counts.
+        o_b, c_b, b_b = binning.sorted_dest_counts_batched(dest_key[None], R)
+        order, full_counts, bounds = o_b[0], c_b[0], b_b[0]
         desired = jnp.minimum(full_counts, C).astype(jnp.int32)
 
         # Receiver-side flow control (lossless receive): exchange DESIRED
@@ -1035,6 +1036,8 @@ def shard_migrate_vranks_fn(
         # leaver prefix bit-for-bit at ~2.4x the flat packed sort's speed
         # (56.3 -> 23.6 ms at 64x1M, scripts/microbench_select.py); a
         # scalar guard cond-routes dense steps to the flat sort.
+        # order is prefix-only (zero tail past the leavers; see
+        # sorted_dest_counts_batched) — reads below slice/mask at counts.
         order, counts, bounds = binning.sorted_dest_counts_batched(
             dest_key, R_total
         )  # [V, n], [V, R_total], [V, R_total + 1]
