@@ -17,6 +17,7 @@ from mpi_grid_redistribute_tpu.api import (
     redistribute,
 )
 from mpi_grid_redistribute_tpu.parallel.exchange import RedistributeStats
+from mpi_grid_redistribute_tpu.parallel.halo import HaloResult
 
 __version__ = "0.1.0"
 
@@ -25,6 +26,7 @@ __all__ = [
     "GridEdges",
     "ProcessGrid",
     "GridRedistribute",
+    "HaloResult",
     "RedistributeResult",
     "RedistributeStats",
     "redistribute",

@@ -31,6 +31,8 @@ import numpy as np
 from mpi_grid_redistribute_tpu.domain import Domain, GridEdges, ProcessGrid
 from mpi_grid_redistribute_tpu import oracle
 from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
+from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
+from mpi_grid_redistribute_tpu.parallel.halo import HaloResult
 
 
 class RedistributeResult(NamedTuple):
@@ -188,6 +190,60 @@ def _build_planar_mesh_call(
     return jax.jit(call)
 
 
+@functools.lru_cache(maxsize=64)
+def _build_halo_planar_vranks_call(
+    domain: Domain, grid: ProcessGrid, widths, pc: int, gc: int, specs
+):
+    """One jitted program: boundary fuse -> planar vrank halo ->
+    boundary unfuse (single dispatch per call)."""
+    V = grid.nranks
+    engine = halo_lib.vrank_halo_planar_fn(domain, grid, widths, pc, gc)
+
+    def call(positions, count, *fields):
+        n_local = positions.shape[0] // V
+        fused = _fuse_planar(positions, fields, V, n_local, specs,
+                             stacked=True)
+        ghost, gcount, overflow = engine(fused, count)
+        gpos, gfields = _unfuse_planar(ghost, specs, V, gc, stacked=True)
+        return gpos, gcount, gfields, overflow
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_halo_planar_mesh_call(
+    mesh, domain: Domain, grid: ProcessGrid, widths, pc: int, gc: int,
+    specs,
+):
+    """One jitted program: boundary fuse -> shard_map planar halo ->
+    boundary unfuse (single dispatch per call)."""
+    R = grid.nranks
+    engine = halo_lib.build_halo_planar(mesh, domain, grid, widths, pc, gc)
+
+    def call(positions, count, *fields):
+        n_local = positions.shape[0] // R
+        fused = _fuse_planar(positions, fields, R, n_local, specs,
+                             stacked=False)
+        ghost, gcount, overflow = engine(fused, count)
+        gpos, gfields = _unfuse_planar(ghost, specs, R, gc, stacked=False)
+        return gpos, gcount, gfields, overflow
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_halo_rowmajor_mesh(
+    mesh, domain: Domain, grid: ProcessGrid, widths, pc: int, gc: int,
+    n_fields: int,
+):
+    """Cached :func:`halo.build_halo_exchange` with pinned capacities —
+    a fresh builder per call would discard its jit cache."""
+    return halo_lib.build_halo_exchange(
+        mesh, domain, grid, widths, pass_capacity=pc, ghost_capacity=gc,
+        n_fields=n_fields,
+    )
+
+
 def _as_domain(domain, lo=None, hi=None, periodic=False) -> Domain:
     if isinstance(domain, Domain):
         return domain
@@ -341,6 +397,7 @@ class GridRedistribute:
         # successfully-read counter snapshot (clean OR lossy)
         self._del_warned = False  # __del__ warns at most once
         self._last_caps = None  # (cap, out_cap, n_local) of the last call
+        self._halo_caps = {}  # widths tuple -> grown (pass_cap, ghost_cap)
         self.capacity = capacity
         self.capacity_factor = float(capacity_factor)
         self.out_capacity = out_capacity
@@ -587,6 +644,153 @@ class GridRedistribute:
         raise RuntimeError(
             f"capacity growth did not converge in {max_attempts} attempts"
         )
+
+    def halo(
+        self,
+        positions,
+        *fields,
+        width,
+        count=None,
+        headroom: float = 2.0,
+        pass_capacity: Optional[int] = None,
+        ghost_capacity: Optional[int] = None,
+    ) -> HaloResult:
+        """Ghost/overlap exchange (SURVEY.md C8): one call returns, for
+        every shard, copies of the neighbor shards' particles within
+        ``width`` of its subdomain faces — the reference family's
+        "overlap width parameter" as a method on the user-facing tool.
+
+        Args:
+          positions: ``[R * n_local, ndim]`` in the same global padded
+            layout as :meth:`redistribute` (typically its output).
+          *fields: 32-bit per-particle arrays riding along (ids, masses).
+          width: scalar or per-axis halo width in domain units; must not
+            exceed the per-axis subdomain width (one-hop shell).
+          count: ``[R]`` valid-row counts (e.g. ``result.count``).
+          headroom: multiplier for the derived capacities
+            (:func:`~.parallel.halo.default_capacities`).
+          pass_capacity / ghost_capacity: explicit capacity pins; by
+            default sized from the halo-volume fraction, and GROWN on
+            measured overflow under ``on_overflow='grow'`` (grown sizes
+            stick on the instance per width, like redistribute's
+            capacities). ``'raise'`` raises on any overflow; ``'ignore'``
+            returns with ``HaloResult.overflow`` surfaced.
+
+        Returns a :class:`HaloResult`: ``ghost_positions``
+        ``[R * ghost_capacity, ndim]`` (shifted into each receiver's
+        frame across periodic wraps), ``ghost_count [R]``,
+        ``ghost_fields``, ``overflow [R]``. Engine selection mirrors
+        :meth:`redistribute`: planar ``[K, n]`` twins when every array is
+        32-bit (24 ns/ghost at config-6 shapes vs 181.7 row-major —
+        BENCH_CONFIGS.md), vrank twins when the grid exceeds the device
+        count — bit-identical ghosts either way.
+        """
+        if self.backend != "jax":
+            raise ValueError(
+                "halo() runs on the jax backend; for NumPy-side "
+                "validation use oracle.brute_force_ghosts (the set-level "
+                "ghost oracle)"
+            )
+        if self.edges is not None:
+            raise ValueError(
+                "halo() requires uniform cells (edges=None): the halo "
+                "engines' face predicates assume uniform subdomain "
+                "widths — rebalance with GridEdges only on the "
+                "redistribute path, or rebuild without edges for ghosts"
+            )
+        positions, fields, n_local, count = self._check_inputs(
+            positions, fields, count
+        )
+        widths = halo_lib._as_per_axis(width, self.domain.ndim)
+        dpc, dgc = halo_lib.default_capacities(
+            self.domain, self.grid, widths, n_local, headroom
+        )
+        grown_pc, grown_gc = self._halo_caps.get(widths, (0, 0))
+        pc = pass_capacity if pass_capacity is not None else max(dpc, grown_pc)
+        gc = ghost_capacity if ghost_capacity is not None else max(dgc, grown_gc)
+        max_attempts = 5
+        for _ in range(max_attempts):
+            result = self._halo_once(positions, fields, count, widths, pc, gc)
+            if self.on_overflow == "ignore":
+                return result  # async preserved: no host sync on stats
+            overflow = np.asarray(result.overflow)
+            total_ov = int(overflow.sum())
+            if not total_ov:
+                return result
+            if self.on_overflow == "raise":
+                raise RuntimeError(
+                    f"halo overflow: {total_ov} ghosts dropped at "
+                    f"pass_capacity={pc}, ghost_capacity={gc} — raise "
+                    f"capacities/headroom or use on_overflow='grow'"
+                )
+            if pass_capacity is not None and ghost_capacity is not None:
+                raise RuntimeError(
+                    f"halo overflow: {total_ov} ghosts dropped at the "
+                    f"explicitly pinned capacities ({pc}, {gc})"
+                )
+            # grow: the overflow counter aggregates pass- and ghost-
+            # capacity drops (they cascade), so grow the ghost budget by
+            # the measured per-shard worst case and double the pass
+            # budget, bucketed to powers of two like redistribute.
+            max_ov = int(overflow.max())
+            if pass_capacity is None:
+                pc = _next_pow2(2 * pc)
+            if ghost_capacity is None:
+                gc = _next_pow2(gc + max_ov)
+            self._halo_caps[widths] = (
+                max(pc, grown_pc), max(gc, grown_gc)
+            )
+        raise RuntimeError(
+            f"halo capacity growth did not converge in {max_attempts} "
+            f"attempts (last: pass_capacity={pc}, ghost_capacity={gc})"
+        )
+
+    def _halo_once(
+        self, positions, fields, count, widths, pc: int, gc: int
+    ) -> HaloResult:
+        specs = None
+        if self.engine in ("auto", "planar"):
+            specs = _planar_specs(positions, fields)
+            if specs is None and self.engine == "planar":
+                raise TypeError(
+                    "engine='planar' requires 32-bit positions and fields "
+                    "(they ride bitcast to int32 rows); cast or use "
+                    "engine='auto'/'rowmajor'"
+                )
+        R = self.nranks
+        n_local = positions.shape[0] // R
+        if specs is not None:
+            if self._vranks:
+                fn = _build_halo_planar_vranks_call(
+                    self.domain, self.grid, widths, pc, gc, specs
+                )
+            else:
+                fn = _build_halo_planar_mesh_call(
+                    self.mesh, self.domain, self.grid, widths, pc, gc,
+                    specs,
+                )
+            gpos, gcount, gfields, overflow = fn(positions, count, *fields)
+            return HaloResult(gpos, gcount, gfields, overflow)
+        if self._vranks:
+            fn = halo_lib.build_halo_vranks(
+                self.domain, self.grid, widths, pc, gc
+            )
+            out = fn(
+                positions.reshape(R, n_local, -1),
+                count,
+                *(f.reshape((R, n_local) + f.shape[1:]) for f in fields),
+            )
+            unstack = lambda a: a.reshape((R * gc,) + a.shape[2:])
+            return HaloResult(
+                unstack(out[0]),
+                out[1],
+                tuple(unstack(f) for f in out[2:-1]),
+                out[-1],
+            )
+        fn = _build_halo_rowmajor_mesh(
+            self.mesh, self.domain, self.grid, widths, pc, gc, len(fields)
+        )
+        return fn(positions, count, *fields)
 
     def _grow(
         self, dropped_send, dropped_recv, needed, needed_out, n_local,

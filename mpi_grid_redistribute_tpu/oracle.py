@@ -211,3 +211,54 @@ def assert_ownership(
                 f"rank {r}: {bad.size} particles outside subdomain, e.g. "
                 f"{np.asarray(pos)[bad[0]]} -> rank {dest[bad[0]]}"
             )
+
+
+def brute_force_ghosts(
+    domain: Domain,
+    grid: ProcessGrid,
+    pos_shards: Sequence[np.ndarray],
+    halo_width,
+) -> List[np.ndarray]:
+    """Set-level halo/ghost oracle (SURVEY.md C8): for each rank, every
+    particle (from any shard, under every periodic image shift) that lies
+    inside the rank's subdomain expanded by ``halo_width`` but NOT inside
+    the subdomain itself. O(R^2 * N * 3^D) — validation only.
+
+    The device engines additionally fix a deterministic ghost ORDER
+    (axis-pass append order); this oracle defines the ghost SET, compared
+    after canonical row sorting. Scalar ``halo_width`` broadcasts over
+    axes; per-axis widths are honored.
+    """
+    import itertools
+
+    R = grid.nranks
+    ndim = domain.ndim
+    ext = np.asarray(domain.extent)
+    w = np.asarray(halo_width, dtype=np.float64)
+    if w.ndim == 0:
+        w = np.full((ndim,), float(w))
+    shifts = []
+    for vec in itertools.product(*[
+        (-1, 0, 1) if domain.periodic[a] else (0,) for a in range(ndim)
+    ]):
+        shifts.append(np.asarray(vec) * ext)
+    out = []
+    for d in range(R):
+        lo, hi = grid.subdomain_of_rank(d, domain)
+        lo, hi = np.asarray(lo), np.asarray(hi)
+        ghosts = []
+        for s in range(R):
+            for p in pos_shards[s]:
+                for v in shifts:
+                    q = p + v
+                    if (q >= lo - w).all() and (q < hi + w).all():
+                        inside = (q >= lo).all() and (q < hi).all()
+                        if inside:
+                            continue  # owned by d; only shell copies count
+                        ghosts.append(q)
+        out.append(
+            np.asarray(ghosts, dtype=np.float32)
+            if ghosts
+            else np.zeros((0, ndim), np.float32)
+        )
+    return out
