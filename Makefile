@@ -1,0 +1,20 @@
+PY ?= python
+
+.PHONY: test lint lint-json baseline
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# gridlint: AST-based SPMD/JIT invariant checker (G001-G005).
+# Exit 0 = clean or fully baselined; 1 = new findings or stale baseline
+# entries; 2 = usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
+lint:
+	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --check
+
+lint-json:
+	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --format=json
+
+# regenerate the grandfathered-findings file (then hand-edit each
+# entry's justification — a bare regen is not a justification)
+baseline:
+	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --write-baseline

@@ -1,0 +1,60 @@
+"""gridlint — AST-based SPMD/JIT invariant checker for this repo.
+
+The redistribute hot path's whole value proposition is that it compiles
+to ONE static-shape SPMD program per (N, capacity) bucket with
+collectives riding ICI (``parallel/exchange.py``, PAPER.md §7.6). The
+invariants that make that true — no data-dependent shapes in jitted
+code, no host syncs in hot paths, collectives issued unconditionally
+and in program order inside ``shard_map`` bodies — were previously
+enforced only by convention. This package enforces them as named,
+suppressible static-analysis rules:
+
+========  ==============================================================
+G001      collectives inside ``shard_map`` bodies must not sit under
+          data-dependent ``if``/``while``/``try`` (deadlock hazard) or
+          inside ``lax.cond``/``lax.while_loop``/``lax.switch`` branch
+          functions, and literal ``axis_name`` arguments must match an
+          axis declared in a mesh construction.
+G002      jit-boundary hygiene: no ``.item()``, ``jax.device_get``,
+          ``np.asarray``/``np.array``, or ``int()``/``float()``/
+          ``bool()`` on traced values inside jit-reachable functions.
+G003      dynamic-shape escapes: ``jnp.nonzero``/``jnp.unique``/
+          ``jnp.argwhere``/``jnp.flatnonzero`` and 1-arg ``jnp.where``
+          without ``size=``, and boolean-mask indexing, in jitted code.
+G004      planar-engine 32-bit row contract: ``fuse_fields`` /
+          ``_fuse_planar`` call sites must be guarded by an
+          ``.itemsize`` check like ``api.py``'s ``_planar_specs``.
+G005      Pallas kernel lint: every ``pl.pallas_call`` passes explicit
+          ``grid`` and ``BlockSpec``s; kernels using ``pl.program_id``
+          must bound-check derived indices.
+========  ==============================================================
+
+Suppress a finding with a same-line comment ``# gridlint: disable=G00x``
+(comma-separate several rules) or a whole file with
+``# gridlint: disable-file=G00x``. Grandfathered findings live in the
+committed baseline file ``analysis/gridlint_baseline.json``.
+
+CLI: ``python scripts/gridlint.py [paths] [--format=json] [--check]``.
+"""
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    Project,
+    RULE_IDS,
+    run_gridlint,
+)
+from mpi_grid_redistribute_tpu.analysis.baseline import (
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "Project",
+    "RULE_IDS",
+    "run_gridlint",
+    "default_baseline_path",
+    "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,88 @@
+"""Baseline (grandfathered-findings) support for gridlint.
+
+A baseline file records findings that predate the linter and are
+accepted as-is, so the check can gate *new* violations at zero while
+old ones are paid down incrementally. Entries match on the
+line-insensitive :meth:`Finding.baseline_key` — (rule, path, symbol,
+message) — so unrelated edits that shift line numbers do not churn the
+file. Every entry must carry a human-written ``justification``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, List, Sequence, Set, Tuple
+
+from mpi_grid_redistribute_tpu.analysis.core import Finding
+
+BaselineKey = Tuple[str, str, str, str]
+
+_BASELINE_NAME = "gridlint_baseline.json"
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _BASELINE_NAME)
+
+
+def load_baseline(path: str) -> Set[BaselineKey]:
+    """Read a baseline file into the set of suppressed finding keys.
+
+    A missing file is an empty baseline. A malformed file is an error —
+    silently ignoring it would un-gate every grandfathered finding.
+    """
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("findings", data if isinstance(data, list) else [])
+    keys: Set[BaselineKey] = set()
+    for e in entries:
+        try:
+            keys.add((e["rule"], e["path"], e["symbol"], e["message"]))
+        except (TypeError, KeyError) as exc:
+            raise SystemExit(
+                f"gridlint: malformed baseline entry in {path}: {e!r} ({exc})"
+            )
+    return keys
+
+
+def write_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    justification: str = "grandfathered at baseline creation",
+) -> None:
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "symbol": f.symbol,
+            "message": f.message,
+            "justification": justification,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    payload = {
+        "comment": (
+            "gridlint baseline: findings accepted at linter introduction. "
+            "Matching is line-insensitive (rule, path, symbol, message). "
+            "Remove entries as the underlying code is fixed; never add "
+            "entries to dodge a new finding — fix or inline-suppress with "
+            "a reason instead."
+        ),
+        "findings": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def split_baselined(
+    findings: Iterable[Finding], baseline: Set[BaselineKey]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Partition into (new, grandfathered) against ``baseline``."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if f.baseline_key() in baseline else new).append(f)
+    return new, old

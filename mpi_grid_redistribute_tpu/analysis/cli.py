@@ -1,0 +1,169 @@
+"""gridlint command-line interface.
+
+Exit codes: 0 — clean (or everything baselined); 1 — non-baselined
+violations; 2 — usage error or unparseable input. ``--check`` is the CI
+entry point (same semantics, but also fails on a stale baseline entry
+that no longer matches anything, so the baseline can only shrink).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from mpi_grid_redistribute_tpu.analysis.baseline import (
+    default_baseline_path,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from mpi_grid_redistribute_tpu.analysis.core import (
+    RULE_IDS,
+    run_gridlint,
+)
+
+_RULE_DOCS = {
+    "G001": "no data-dependent collectives in shard_map bodies; "
+    "axis_name literals must be declared mesh axes",
+    "G002": "no host syncs (.item/device_get/np.asarray/int()/float()) "
+    "in jit-reachable code",
+    "G003": "no dynamic-shape escapes (unsized nonzero/unique/where, "
+    "boolean-mask indexing) in jitted code",
+    "G004": "fuse_fields/bitcast call paths must carry a dtype.itemsize "
+    "guard (planar 32-bit row contract)",
+    "G005": "pallas_call must pass explicit grid and BlockSpecs; "
+    "program_id-derived indices must be bounded",
+}
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gridlint",
+        description="AST-based SPMD/JIT invariant checker for "
+        "mpi_grid_redistribute_tpu.",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["mpi_grid_redistribute_tpu/"],
+        help="files or directories to scan (default: the package)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format",
+    )
+    p.add_argument(
+        "--rules",
+        default=None,
+        metavar="G00x[,G00y]",
+        help="comma-separated subset of rules to run",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help=f"baseline file (default: {default_baseline_path()})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline; report every finding",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: additionally fail on stale baseline entries",
+    )
+    p.add_argument(
+        "--root",
+        default=None,
+        help="path-relativization root (default: cwd)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid in RULE_IDS:
+            print(f"{rid}  {_RULE_DOCS[rid]}")
+        return 0
+
+    rules: Optional[List[str]] = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULE_IDS]
+        if unknown:
+            print(
+                f"gridlint: unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(RULE_IDS)})",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        findings = run_gridlint(args.paths, root=args.root, rules=rules)
+    except SystemExit as e:  # parse errors from build_project
+        print(str(e), file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(
+            f"gridlint: wrote {len(findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(baseline_path)
+    new, grandfathered = split_baselined(findings, baseline)
+
+    stale: List[tuple] = []
+    if args.check and baseline:
+        matched = {f.baseline_key() for f in grandfathered}
+        stale = sorted(baseline - matched)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in new],
+                    "baselined": len(grandfathered),
+                    "stale_baseline": [list(k) for k in stale],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(
+                f"stale baseline entry (code fixed? remove it): "
+                f"{key[0]} {key[1]} [{key[2]}]"
+            )
+        summary = f"gridlint: {len(new)} finding(s)"
+        if grandfathered:
+            summary += f", {len(grandfathered)} baselined"
+        if stale:
+            summary += f", {len(stale)} stale baseline entr(y/ies)"
+        print(summary)
+
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

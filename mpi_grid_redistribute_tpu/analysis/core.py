@@ -1,0 +1,732 @@
+"""gridlint core: project model, traced-scope inference, rule registry.
+
+Everything here is plain ``ast`` — importing a scanned module is never
+required (the analyzer must be able to lint files that do not import in
+the current environment, e.g. TPU-only scripts).
+
+The two scope facts every rule keys off:
+
+* **jit-reachable** — functions traced under ``jax.jit``: decorated
+  with ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``, passed to
+  ``jax.jit(...)`` / ``jax.vmap(...)``, returned (possibly wrapped in
+  ``jax.jit``) by a builder whose result is jitted, or transitively
+  called from any of those. shard_map bodies are jit-reachable too.
+* **shard_map body** — functions passed (directly, or as a builder's
+  return value) to ``shard_map(...)``, plus functions transitively
+  called from them. Collective-order rules (G001) apply only here.
+
+Call edges resolve module-locally by simple name and cross-module
+through ``from pkg.mod import name`` / ``pkg.mod.name`` attribute calls
+over the scanned file set. This is an approximation (no dynamic
+dispatch), documented as such; in exchange the analyzer is fast, has no
+import side effects, and never hallucinates reachability it cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULE_IDS = ("G001", "G002", "G003", "G004", "G005")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*gridlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>(?:G\d{3}|all)(?:\s*,\s*(?:G\d{3}|all))*)"
+)
+
+# collective primitives whose ordering inside shard_map bodies is a
+# deadlock contract (G001). axis-name argument position per primitive.
+COLLECTIVES: Dict[str, int] = {
+    "all_to_all": 1,
+    "ppermute": 1,
+    "psum": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "pmean": 1,
+    "pshuffle": 1,
+    "all_gather": 1,
+    "psum_scatter": 1,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    symbol: str = ""  # enclosing function qualname, "" at module level
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def baseline_key(self) -> Tuple[str, str, str, str]:
+        """Line-number-insensitive identity used for baseline matching:
+        edits above a grandfathered finding must not un-baseline it."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{loc}: {self.rule}{sym}: {self.message}"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function (or lambda) definition inside a module."""
+
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    module: "ModuleInfo"
+    params: Tuple[str, ...]
+    parent: Optional["FunctionInfo"]  # lexically enclosing function
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+class ModuleInfo:
+    """Parsed module: AST, source lines, suppressions, function index."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._scan_suppressions()
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        # import alias -> dotted module ("np" -> "numpy"); from-imports
+        # record name -> "module.attr" in from_imports
+        self.import_aliases: Dict[str, str] = {}
+        self.from_imports: Dict[str, str] = {}
+        self._index()
+
+    # -- suppressions ---------------------------------------------------
+
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            if "all" in rules:
+                rules = set(RULE_IDS)
+            if m.group("file"):
+                self.file_suppressions |= rules
+            else:
+                self.line_suppressions.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions:
+            return True
+        return rule in self.line_suppressions.get(line, set())
+
+    # -- indexing -------------------------------------------------------
+
+    def _index(self) -> None:
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.stack: List[FunctionInfo] = []
+
+            def _add(self, node, name: str) -> FunctionInfo:
+                parent = self.stack[-1] if self.stack else None
+                qual = f"{parent.qualname}.{name}" if parent else name
+                if isinstance(node, ast.Lambda):
+                    args = node.args
+                else:
+                    args = node.args
+                params = tuple(
+                    a.arg
+                    for a in (
+                        list(args.posonlyargs)
+                        + list(args.args)
+                        + list(args.kwonlyargs)
+                        + ([args.vararg] if args.vararg else [])
+                        + ([args.kwarg] if args.kwarg else [])
+                    )
+                )
+                fi = FunctionInfo(qual, node, mod, params, parent)
+                mod.functions[qual] = fi
+                mod.by_name.setdefault(fi.name, []).append(fi)
+                node._gridlint_info = fi  # type: ignore[attr-defined]
+                return fi
+
+            def visit_FunctionDef(self, node):
+                fi = self._add(node, node.name)
+                self.stack.append(fi)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                fi = self._add(node, f"<lambda:{node.lineno}>")
+                self.stack.append(fi)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            def visit_Import(self, node):
+                for alias in node.names:
+                    mod.import_aliases[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+
+            def visit_ImportFrom(self, node):
+                if node.module is None or node.level:
+                    return
+                for alias in node.names:
+                    mod.from_imports[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+        V().visit(self.tree)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def last_attr(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def get_arg(
+    call: ast.Call, pos: Optional[int], kw: str
+) -> Optional[ast.AST]:
+    """Positional-or-keyword argument lookup (no starred handling);
+    ``pos=None`` looks up keyword-only."""
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    plain = [a for a in call.args if not isinstance(a, ast.Starred)]
+    if (
+        pos is not None
+        and len(plain) == len(call.args)
+        and 0 <= pos < len(plain)
+    ):
+        return plain[pos]
+    return None
+
+
+class Project:
+    """The scanned file set plus cross-module scope inference."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = list(modules)
+        self.by_relpath = {m.relpath: m for m in self.modules}
+        # dotted module name (best effort from relpath) -> ModuleInfo
+        self.by_modname: Dict[str, ModuleInfo] = {}
+        for m in self.modules:
+            name = m.relpath[:-3].replace("/", ".")
+            if name.endswith(".__init__"):
+                name = name[: -len(".__init__")]
+            self.by_modname[name] = m
+        self.jit_reachable: Set[Tuple[str, str]] = set()  # (relpath, qual)
+        self.shardmap_scope: Set[Tuple[str, str]] = set()
+        self.axis_literals: Set[str] = set()
+        self._infer()
+
+    # -- resolution helpers --------------------------------------------
+
+    @staticmethod
+    def _lexically_visible(
+        cands: List[FunctionInfo], scope: Optional[FunctionInfo]
+    ) -> List[FunctionInfo]:
+        """Filter same-simple-name candidates to those actually visible
+        from ``scope``: module-level defs plus defs nested in the scope
+        chain. Without this, ``jit(loop)`` in one builder would mark
+        every other builder's local ``loop`` as traced."""
+        chain_ids = {id(None)}
+        fi = scope
+        while fi is not None:
+            chain_ids.add(id(fi))
+            fi = fi.parent
+        visible = [c for c in cands if id(c.parent) in chain_ids]
+        return visible or list(cands)
+
+    def resolve_call_target(
+        self, mod: ModuleInfo, name: str, scope: Optional[FunctionInfo]
+    ) -> List[FunctionInfo]:
+        """Best-effort resolution of a call target to project functions."""
+        out: List[FunctionInfo] = []
+        head = name.split(".", 1)[0]
+        tail = last_attr(name)
+        # local / enclosing-scope / module-level function by simple name
+        if "." not in name:
+            # prefer the lexically closest definition
+            cands = mod.by_name.get(name, [])
+            if cands:
+                return self._lexically_visible(cands, scope)
+            target = mod.from_imports.get(name)
+            if target:
+                tmod_name, _, tfn = target.rpartition(".")
+                tmod = self.by_modname.get(tmod_name)
+                if tmod:
+                    out.extend(tmod.by_name.get(tfn, []))
+            return out
+        # module-attribute call: resolve head through imports
+        target_mod: Optional[ModuleInfo] = None
+        if head in mod.from_imports:
+            target_mod = self.by_modname.get(mod.from_imports[head])
+        if target_mod is None and head in mod.import_aliases:
+            target_mod = self.by_modname.get(mod.import_aliases[head])
+        if target_mod is not None:
+            out.extend(target_mod.by_name.get(tail, []))
+        return out
+
+    def _returned_functions(self, fi: FunctionInfo) -> List[FunctionInfo]:
+        """Nested functions a builder returns (possibly via jax.jit(...)/
+        functools.partial(...) wrapping or a local alias)."""
+        out: List[FunctionInfo] = []
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            return out
+
+        local_defs = {
+            f.name: f
+            for f in fi.module.functions.values()
+            if f.parent is fi
+        }
+
+        def peel(expr: ast.AST, depth: int = 0) -> None:
+            if depth > 4 or expr is None:
+                return
+            if isinstance(expr, ast.Name) and expr.id in local_defs:
+                out.append(local_defs[expr.id])
+                return
+            if isinstance(expr, ast.Call):
+                fn = last_attr(call_name(expr))
+                if fn in ("jit", "partial", "lru_cache", "wraps", "vmap"):
+                    for a in expr.args:
+                        peel(a, depth + 1)
+
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                peel(sub.value)
+        return out
+
+    def _traced_exprs(
+        self, mod: ModuleInfo, expr: ast.AST, scope: Optional[FunctionInfo]
+    ) -> List[FunctionInfo]:
+        """Functions denoted by an expression passed to jit/shard_map:
+        a name, a lambda, a builder call, or a partial/jit wrapper."""
+        out: List[FunctionInfo] = []
+        if isinstance(expr, ast.Lambda):
+            info = getattr(expr, "_gridlint_info", None)
+            if info is not None:
+                out.append(info)
+            return out
+        if isinstance(expr, ast.Name):
+            # a def visible from this scope?
+            cands = mod.by_name.get(expr.id, [])
+            if cands:
+                return self._lexically_visible(cands, scope)
+            # a local alias: `fn = builder(...)` then shard_map(fn, ...)
+            if scope is not None and not isinstance(scope.node, ast.Lambda):
+                for sub in ast.walk(scope.node):
+                    if (
+                        isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)
+                        and sub.targets[0].id == expr.id
+                    ):
+                        out.extend(self._traced_exprs(mod, sub.value, scope))
+            target = mod.from_imports.get(expr.id)
+            if target:
+                tmod_name, _, tfn = target.rpartition(".")
+                tmod = self.by_modname.get(tmod_name)
+                if tmod:
+                    out.extend(tmod.by_name.get(tfn, []))
+            return out
+        if isinstance(expr, ast.Call):
+            fn = call_name(expr)
+            tail = last_attr(fn)
+            if tail in ("jit", "partial", "vmap", "shard_map"):
+                tgt = expr.args[0] if expr.args else get_arg(expr, 0, "f")
+                if tgt is not None:
+                    out.extend(self._traced_exprs(mod, tgt, scope))
+                return out
+            # builder call: whatever the builder returns
+            for bi in self.resolve_call_target(mod, fn or "", scope):
+                out.extend(self._returned_functions(bi))
+        return out
+
+    # -- scope inference ------------------------------------------------
+
+    def _infer(self) -> None:
+        jit_roots: Set[Tuple[str, str]] = set()
+        sm_roots: Set[Tuple[str, str]] = set()
+
+        for mod in self.modules:
+            for fi in mod.functions.values():
+                node = fi.node
+                if isinstance(node, ast.Lambda):
+                    continue
+                for dec in node.decorator_list:
+                    d = dec
+                    if isinstance(d, ast.Call):
+                        nm = last_attr(call_name(d))
+                        if nm == "jit":
+                            jit_roots.add((mod.relpath, fi.qualname))
+                        elif nm == "partial":
+                            inner = [
+                                last_attr(dotted_name(a))
+                                for a in d.args
+                                if dotted_name(a)
+                            ]
+                            if "jit" in inner:
+                                jit_roots.add((mod.relpath, fi.qualname))
+                    elif last_attr(dotted_name(d)) == "jit":
+                        jit_roots.add((mod.relpath, fi.qualname))
+
+            # call-form roots: jax.jit(f) / shard_map(f, ...) anywhere
+            for scope_node in ast.walk(mod.tree):
+                if not isinstance(scope_node, ast.Call):
+                    continue
+                nm = last_attr(call_name(scope_node))
+                if nm not in ("jit", "shard_map", "vmap"):
+                    continue
+                scope = self._enclosing_function(mod, scope_node)
+                tgt = scope_node.args[0] if scope_node.args else get_arg(
+                    scope_node, 0, "f"
+                )
+                if tgt is None:
+                    continue
+                for fi in self._traced_exprs(mod, tgt, scope):
+                    key = (fi.module.relpath, fi.qualname)
+                    jit_roots.add(key)
+                    if nm == "shard_map":
+                        sm_roots.add(key)
+
+            # axis-name literals declared in mesh constructions
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    nm = last_attr(call_name(node))
+                    if nm in ("Mesh", "ProcessGrid", "make_mesh", "AbstractMesh"):
+                        ax = get_arg(node, 1, "axis_names")
+                        self._collect_str_literals(ax)
+                elif isinstance(node, ast.Assign):
+                    tgts = [
+                        t
+                        for t in node.targets
+                        if last_attr(dotted_name(t)).startswith("axis_names")
+                        or (isinstance(t, ast.Name) and t.id == "axis_names")
+                    ]
+                    if tgts:
+                        self._collect_str_literals(node.value)
+
+        self.jit_reachable = self._close_over_calls(jit_roots)
+        self.shardmap_scope = self._close_over_calls(sm_roots)
+
+    def _collect_str_literals(self, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                self.axis_literals.add(sub.value)
+
+    def _enclosing_function(
+        self, mod: ModuleInfo, target: ast.AST
+    ) -> Optional[FunctionInfo]:
+        """The innermost FunctionInfo whose node contains ``target``."""
+        best: Optional[FunctionInfo] = None
+        best_span = None
+        for fi in mod.functions.values():
+            node = fi.node
+            lo = node.lineno
+            hi = getattr(node, "end_lineno", lo)
+            if lo <= target.lineno <= hi:
+                span = hi - lo
+                if best is None or span < best_span:
+                    best, best_span = fi, span
+        return best
+
+    def _close_over_calls(
+        self, roots: Set[Tuple[str, str]]
+    ) -> Set[Tuple[str, str]]:
+        """Transitive closure of project-resolvable call edges. A nested
+        def lexically inside a reached function is reached too (it is
+        traced when its parent runs)."""
+        reached: Set[Tuple[str, str]] = set()
+        frontier = list(roots)
+        while frontier:
+            key = frontier.pop()
+            if key in reached:
+                continue
+            reached.add(key)
+            mod = self.by_relpath.get(key[0])
+            if mod is None:
+                continue
+            fi = mod.functions.get(key[1])
+            if fi is None:
+                continue
+            # lexically nested defs
+            for sub in mod.functions.values():
+                if sub.parent is fi:
+                    frontier.append((mod.relpath, sub.qualname))
+            # call edges out of this function's own statements (do not
+            # descend into nested defs: they are pushed separately above,
+            # and their bodies' calls belong to them)
+            for call in self._own_calls(fi):
+                nm = call_name(call)
+                if not nm:
+                    continue
+                for tgt in self.resolve_call_target(mod, nm, fi):
+                    frontier.append((tgt.module.relpath, tgt.qualname))
+        return reached
+
+    @staticmethod
+    def _own_calls(fi: FunctionInfo) -> Iterable[ast.Call]:
+        """Call nodes in ``fi``'s body, including nested lambdas/defs
+        (reaching them there is fine — a call inside a nested def fires
+        when the parent is traced in this codebase's builder idiom)."""
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                yield node
+
+    # -- queries used by rules ------------------------------------------
+
+    def is_jit_reachable(self, fi: FunctionInfo) -> bool:
+        return (fi.module.relpath, fi.qualname) in self.jit_reachable
+
+    def is_shardmap_scope(self, fi: FunctionInfo) -> bool:
+        return (fi.module.relpath, fi.qualname) in self.shardmap_scope
+
+    def traced_functions(self) -> List[FunctionInfo]:
+        out = []
+        for relpath, qual in sorted(self.jit_reachable):
+            mod = self.by_relpath.get(relpath)
+            if mod and qual in mod.functions:
+                out.append(mod.functions[qual])
+        return out
+
+    def shardmap_functions(self) -> List[FunctionInfo]:
+        out = []
+        for relpath, qual in sorted(self.shardmap_scope):
+            mod = self.by_relpath.get(relpath)
+            if mod and qual in mod.functions:
+                out.append(mod.functions[qual])
+        return out
+
+
+# -- taint: which local names carry traced values -----------------------
+
+
+# annotations that mark a parameter as host-side config, never a traced
+# array: builtin scalars plus this repo's static descriptor classes
+# (hashable jit-static arguments — Domain/ProcessGrid are frozen
+# dataclasses baked into the compiled program, not operands)
+_STATIC_ANNOTATIONS = frozenset(
+    {
+        "int",
+        "float",
+        "bool",
+        "str",
+        "bytes",
+        "Domain",
+        "GridEdges",
+        "ProcessGrid",
+        "Mesh",
+        "AbstractMesh",
+    }
+)
+
+
+def _annotation_is_static(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Name) and n.id in _STATIC_ANNOTATIONS:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ANNOTATIONS:
+            return True
+        if (
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and n.value in _STATIC_ANNOTATIONS
+        ):
+            return True
+    return False
+
+
+def _static_params(fi: FunctionInfo) -> Set[str]:
+    """Parameter names whose annotation marks them host-static."""
+    out: Set[str] = set()
+    args = getattr(fi.node, "args", None)
+    if args is None:
+        return out
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        if _annotation_is_static(getattr(a, "annotation", None)):
+            out.add(a.arg)
+    return out
+
+
+def tainted_names(fi: FunctionInfo) -> Set[str]:
+    """Forward may-taint over a traced function's straight-line
+    assignments: parameters are traced; a name assigned from an
+    expression mentioning a traced name (or a jnp/lax call) is traced.
+    ``.shape`` / ``.ndim`` / ``.dtype`` / ``len()`` of a traced value are
+    static under jit and break the chain, as are parameters annotated
+    with a host/config type (``ext: float``, ``domain: Domain``) — the
+    annotation is trusted as a static-argument declaration."""
+    tainted: Set[str] = set(fi.params) - _static_params(fi)
+    node = fi.node
+
+    # two passes make simple forward chains converge (assignments out of
+    # order are rare in this codebase's traced fns)
+    for _ in range(2):
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Assign):
+                if expr_mentions_tainted(stmt.value, tainted):
+                    for t in stmt.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(stmt, ast.AugAssign):
+                if expr_mentions_tainted(
+                    stmt.value, tainted
+                ) and isinstance(stmt.target, ast.Name):
+                    tainted.add(stmt.target.id)
+            elif isinstance(stmt, (ast.For, ast.comprehension)):
+                if expr_mentions_tainted(stmt.iter, tainted):
+                    for n in ast.walk(stmt.target):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+    return tainted
+
+
+# array metadata that is static under jit even on a traced value
+_STATIC_ATTRS = ("shape", "ndim", "size", "itemsize", "dtype", "weak_type")
+_STATIC_CALLS = ("len", "isinstance", "range", "enumerate")
+
+
+def expr_mentions_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """May the VALUE of ``expr`` depend on traced data?
+
+    ``pos.shape[0]``, ``len(pos)``, ``a.ndim`` are static under jit and
+    break the chain; anything else that touches a tainted name taints
+    the result."""
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return expr_mentions_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        if last_attr(call_name(expr)) in _STATIC_CALLS:
+            return False
+        parts = [expr.func] + list(expr.args) + [
+            k.value for k in expr.keywords
+        ]
+        return any(expr_mentions_tainted(p, tainted) for p in parts)
+    return any(
+        expr_mentions_tainted(c, tainted)
+        for c in ast.iter_child_nodes(expr)
+    )
+
+
+# -- rule registry and driver -------------------------------------------
+
+RuleFn = Callable[[Project], List[Finding]]
+_RULES: List[Tuple[str, RuleFn]] = []
+
+
+def rule(rule_id: str):
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES.append((rule_id, fn))
+        return fn
+
+    return deco
+
+
+def iter_py_files(paths: Sequence[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d
+                    for d in dirnames
+                    if d not in ("__pycache__", ".git", ".venv", "node_modules")
+                ]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(os.path.abspath(os.path.join(dirpath, f)))
+    return sorted(set(out))
+
+
+def build_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
+    root = os.path.abspath(root or os.getcwd())
+    modules = []
+    for path in iter_py_files(paths, root):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append(ModuleInfo(path, rel, src))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            raise SystemExit(f"gridlint: cannot parse {rel}: {e}")
+    return Project(modules)
+
+
+def run_gridlint(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Scan ``paths`` and return unsuppressed findings, sorted."""
+    # rule modules register on import
+    from mpi_grid_redistribute_tpu.analysis import (  # noqa: F401
+        rules_collectives,
+        rules_jit,
+        rules_pallas,
+        rules_planar,
+    )
+
+    project = build_project(paths, root)
+    wanted = set(rules) if rules else set(RULE_IDS)
+    findings: List[Finding] = []
+    seen: Set[Tuple] = set()
+    for rule_id, fn in _RULES:
+        if rule_id not in wanted:
+            continue
+        for f in fn(project):
+            mod = project.by_relpath.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,178 @@
+"""G001 — collective ordering and axis-name hygiene in shard_map bodies.
+
+Inside a ``shard_map`` body every rank runs the same program; a
+collective (``lax.all_to_all``, ``ppermute``, ``psum``, ...) is a
+rendezvous, so any rank skipping it — or reaching it a different number
+of times — deadlocks the mesh. Statically that means a collective must
+not sit under:
+
+* a Python ``if``/``while`` whose test may depend on traced data (a
+  trace-time branch on host config like ``domain.periodic[a]`` is fine
+  — every rank traces the same program);
+* a branch function of ``lax.cond`` / ``lax.switch`` / the body or cond
+  of ``lax.while_loop`` (data-dependent control flow on device); or
+* a ``try`` block (an exception path would desynchronize issue order).
+
+Additionally, a literal ``axis_name`` argument must name an axis
+declared in some mesh construction in the scanned project; a literal
+nobody declares is a guaranteed trace error at best and a stale-rename
+deadlock at worst.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    COLLECTIVES,
+    Finding,
+    FunctionInfo,
+    Project,
+    call_name,
+    dotted_name,
+    expr_mentions_tainted,
+    get_arg,
+    last_attr,
+    rule,
+    tainted_names,
+)
+
+# lax control-flow combinators whose function arguments run data-
+# dependently: (name, positions of function-valued args). while_loop's
+# cond and body both count.
+_BRANCH_COMBINATORS = {
+    "cond": (1, 2),
+    "switch": (1,),  # plus *branches — handled as "all args from 1"
+    "while_loop": (0, 1),
+}
+
+
+def _collective_calls(fi: FunctionInfo):
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            tail = last_attr(name)
+            if tail in COLLECTIVES and (
+                name == tail or name.endswith(f"lax.{tail}")
+                or name.startswith("lax.") or name.startswith("jax.")
+            ):
+                yield node, tail
+
+
+def _path_to(root: ast.AST, target: ast.AST) -> Optional[List[ast.AST]]:
+    """Ancestor chain root..target (inclusive), or None."""
+    if root is target:
+        return [root]
+    for child in ast.iter_child_nodes(root):
+        sub = _path_to(child, target)
+        if sub is not None:
+            return [root] + sub
+    return None
+
+
+@rule("G001")
+def check_collectives(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.shardmap_functions():
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            continue
+        taint = tainted_names(fi)
+        # nested functions passed to lax.cond/while_loop/switch within
+        # this body: collectives inside them are data-dependent
+        branch_fns: Set[str] = set()
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            tail = last_attr(call_name(call))
+            if tail not in _BRANCH_COMBINATORS:
+                continue
+            arg_positions = _BRANCH_COMBINATORS[tail]
+            args = call.args
+            take = (
+                range(1, len(args)) if tail == "switch" else arg_positions
+            )
+            for pos in take:
+                if pos < len(args):
+                    nm = dotted_name(args[pos])
+                    if nm and "." not in nm:
+                        branch_fns.add(nm)
+
+        for call, prim in _collective_calls(fi):
+            path = _path_to(node, call)
+            if path is None:  # pragma: no cover - walk() found it above
+                continue
+            # ancestry checks: enclosing try / data-dependent if / while
+            hazard = None
+            enclosing_def = node
+            for anc in path[:-1]:
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing_def = anc
+                    if (
+                        anc is not node
+                        and anc.name in branch_fns
+                    ):
+                        hazard = (
+                            f"collective lax.{prim} inside a lax.cond/"
+                            f"while_loop/switch branch function "
+                            f"'{anc.name}' — data-dependent collective "
+                            f"issue deadlocks the mesh"
+                        )
+                elif isinstance(anc, ast.Try):
+                    hazard = (
+                        f"collective lax.{prim} inside a try block — an "
+                        f"exception path desynchronizes collective issue "
+                        f"order across ranks"
+                    )
+                elif isinstance(anc, (ast.If, ast.While)):
+                    if expr_mentions_tainted(anc.test, taint):
+                        kind = "while" if isinstance(anc, ast.While) else "if"
+                        hazard = (
+                            f"collective lax.{prim} under a data-dependent "
+                            f"`{kind}` (test references traced values) — "
+                            f"ranks may disagree and deadlock; hoist the "
+                            f"collective or select operands with jnp.where"
+                        )
+                if hazard:
+                    break
+            if hazard:
+                findings.append(
+                    Finding(
+                        "G001",
+                        fi.module.relpath,
+                        call.lineno,
+                        call.col_offset,
+                        hazard,
+                        fi.qualname,
+                    )
+                )
+                continue
+
+            # axis-name literal check
+            axis_arg = get_arg(call, COLLECTIVES[prim], "axis_name")
+            if axis_arg is None:
+                continue
+            literals = [
+                s.value
+                for s in ast.walk(axis_arg)
+                if isinstance(s, ast.Constant) and isinstance(s.value, str)
+            ]
+            if not literals or not project.axis_literals:
+                continue
+            unknown = [s for s in literals if s not in project.axis_literals]
+            if unknown:
+                findings.append(
+                    Finding(
+                        "G001",
+                        fi.module.relpath,
+                        call.lineno,
+                        call.col_offset,
+                        f"collective lax.{prim} names axis "
+                        f"{unknown[0]!r} which no mesh construction in "
+                        f"the scanned files declares (known literal axes:"
+                        f" {sorted(project.axis_literals)})",
+                        fi.qualname,
+                    )
+                )
+    return findings

@@ -1,0 +1,207 @@
+"""G002/G003 — jit-boundary hygiene and dynamic-shape escapes.
+
+G002: inside functions reachable from a ``jax.jit`` entry point, host
+syncs and host-array round-trips break the async-dispatch contract (one
+``.item()`` in a hot loop serializes every step on the device stream):
+
+* ``x.item()`` on any value;
+* ``jax.device_get(...)`` / ``x.block_until_ready()``;
+* ``np.asarray(...)`` / ``np.array(...)`` on traced values (numpy
+  forces a device→host copy; ``jnp.asarray`` is the traced spelling);
+* ``int()`` / ``float()`` / ``bool()`` on traced values (a
+  ``TracerBoolConversionError`` at best, a silent host sync when the
+  function escapes jit and runs eagerly).
+
+G003: data-dependent output shapes cannot compile to a single static
+SPMD program — the whole point of the capacity-padded design
+(PAPER.md §7.6 "variable→fixed size gap"):
+
+* ``jnp.nonzero`` / ``jnp.flatnonzero`` / ``jnp.argwhere`` /
+  ``jnp.unique`` without ``size=``;
+* one-argument ``jnp.where(cond)`` (the nonzero form);
+* boolean-mask indexing ``x[mask]`` where the mask is a comparison on
+  traced values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    Project,
+    call_name,
+    expr_mentions_tainted,
+    get_arg,
+    last_attr,
+    rule,
+    tainted_names,
+)
+
+_NUMPY_ALIASES = ("np", "numpy", "onp")
+_SIZED_OR_DIE = ("nonzero", "flatnonzero", "argwhere", "unique")
+
+
+def _numpy_call(name: str) -> bool:
+    head, _, tail = name.rpartition(".")
+    return head in _NUMPY_ALIASES and tail in ("asarray", "array")
+
+
+def _finding(fi: FunctionInfo, node: ast.AST, rule_id: str, msg: str) -> Finding:
+    return Finding(
+        rule_id,
+        fi.module.relpath,
+        node.lineno,
+        node.col_offset,
+        msg,
+        fi.qualname,
+    )
+
+
+@rule("G002")
+def check_jit_hygiene(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.traced_functions():
+        taint = tainted_names(fi)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            tail = last_attr(name)
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "item",
+                "block_until_ready",
+            ) and not node.args:
+                findings.append(
+                    _finding(
+                        fi,
+                        node,
+                        "G002",
+                        f".{node.func.attr}() inside jit-reachable code "
+                        f"forces a blocking host sync; read values after "
+                        f"the jit boundary instead",
+                    )
+                )
+            elif tail == "device_get" and name.startswith("jax"):
+                findings.append(
+                    _finding(
+                        fi,
+                        node,
+                        "G002",
+                        "jax.device_get inside jit-reachable code forces "
+                        "a device→host copy; move the fetch outside the "
+                        "jitted function",
+                    )
+                )
+            elif _numpy_call(name):
+                arg = node.args[0] if node.args else None
+                if arg is not None and expr_mentions_tainted(arg, taint):
+                    findings.append(
+                        _finding(
+                            fi,
+                            node,
+                            "G002",
+                            f"{name}(...) on a traced value inside "
+                            f"jit-reachable code copies device→host; use "
+                            f"jnp.asarray or keep the value on device",
+                        )
+                    )
+            elif (
+                name in ("int", "float", "bool")
+                and len(node.args) == 1
+                and expr_mentions_tainted(node.args[0], taint)
+            ):
+                findings.append(
+                    _finding(
+                        fi,
+                        node,
+                        "G002",
+                        f"{name}() on a traced value inside jit-reachable "
+                        f"code is a host sync (TracerConversionError under "
+                        f"jit); compute with jnp dtype casts instead",
+                    )
+                )
+    return findings
+
+
+@rule("G003")
+def check_dynamic_shapes(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.traced_functions():
+        taint = tainted_names(fi)
+        comparison_masks: Set[str] = _comparison_mask_names(fi, taint)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                head, _, tail = name.rpartition(".")
+                if head not in ("jnp", "jax.numpy", "jax.np"):
+                    continue
+                if tail in _SIZED_OR_DIE and get_arg(node, None, "size") is None:
+                    findings.append(
+                        _finding(
+                            fi,
+                            node,
+                            "G003",
+                            f"jnp.{tail} without size= has a data-"
+                            f"dependent output shape and cannot compile "
+                            f"to a static SPMD program; pass size= (and "
+                            f"fill_value=) to pin the padded shape",
+                        )
+                    )
+                elif (
+                    tail == "where"
+                    and len(node.args) == 1
+                    and not node.keywords
+                ):
+                    findings.append(
+                        _finding(
+                            fi,
+                            node,
+                            "G003",
+                            "one-argument jnp.where is jnp.nonzero in "
+                            "disguise: data-dependent output shape; use "
+                            "the three-argument select form or "
+                            "jnp.nonzero(..., size=...)",
+                        )
+                    )
+            elif isinstance(node, ast.Subscript):
+                sl = node.slice
+                is_mask = isinstance(sl, (ast.Compare, ast.BoolOp)) or (
+                    isinstance(sl, ast.UnaryOp)
+                    and isinstance(sl.op, ast.Not)
+                )
+                if not is_mask and isinstance(sl, ast.Name):
+                    is_mask = sl.id in comparison_masks
+                if (
+                    is_mask
+                    and expr_mentions_tainted(sl, taint)
+                    and expr_mentions_tainted(node.value, taint)
+                ):
+                    findings.append(
+                        _finding(
+                            fi,
+                            node,
+                            "G003",
+                            "boolean-mask indexing on traced values has a "
+                            "data-dependent result shape; use jnp.where "
+                            "masking or a stable pack at fixed capacity",
+                        )
+                    )
+    return findings
+
+
+def _comparison_mask_names(fi: FunctionInfo, taint: Set[str]) -> Set[str]:
+    """Local names assigned a traced comparison (likely boolean masks)."""
+    out: Set[str] = set()
+    for stmt in ast.walk(fi.node):
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, (ast.Compare, ast.BoolOp))
+            and expr_mentions_tainted(stmt.value, taint)
+        ):
+            out.add(stmt.targets[0].id)
+    return out

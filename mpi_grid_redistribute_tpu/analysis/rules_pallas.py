@@ -1,0 +1,193 @@
+"""G005 — Pallas kernel lint.
+
+Two contracts the TPU kernels in ``ops/pallas_*`` must keep:
+
+* every ``pl.pallas_call`` passes an explicit ``grid`` (or a
+  ``grid_spec`` bundling one) and explicit ``in_specs``/``out_specs``
+  BlockSpecs. Relying on defaults means the whole operand lands in one
+  block — fine in tiny tests, silent VMEM blowup at real sizes, and a
+  meaningless comparison against the sized baselines in BENCH.md;
+* any kernel that derives indices from ``pl.program_id`` must bound
+  them. The grid is sized from padded capacities (``_next_pow2``
+  buckets), so the last block routinely covers rows past the valid
+  count; an unclamped ``program_id``-derived offset reads or writes
+  out of bounds. A bounding construct is any of ``jnp.minimum`` /
+  ``maximum`` / ``clip`` / ``where``, ``lax.min`` / ``max`` /
+  ``select``, or a ``pl.when`` guard.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    call_name,
+    get_arg,
+    last_attr,
+    rule,
+)
+
+_BOUNDING_CALLS = {
+    "minimum",
+    "maximum",
+    "clip",
+    "where",
+    "min",
+    "max",
+    "select",
+    "when",
+    "ds",  # pl.ds(start, fixed_size) pins the slice extent
+}
+
+
+def _uses_program_id(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call) and last_attr(call_name(n)) == "program_id"
+        for n in ast.walk(node)
+    )
+
+
+def _has_bounding(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and last_attr(call_name(n)) in _BOUNDING_CALLS:
+            return True
+        # @pl.when used as a decorator factory: pl.when(cond)(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if (
+                    isinstance(dec, ast.Call)
+                    and last_attr(call_name(dec)) == "when"
+                ):
+                    return True
+    return False
+
+
+def _enclosing(mod: ModuleInfo, node: ast.AST) -> Optional[FunctionInfo]:
+    best: Optional[FunctionInfo] = None
+    best_span: Optional[int] = None
+    for fi in mod.functions.values():
+        fn = fi.node
+        lo, hi = fn.lineno, getattr(fn, "end_lineno", fn.lineno)
+        if lo <= node.lineno <= hi:
+            span = hi - lo
+            if best_span is None or span < best_span:
+                best, best_span = fi, span
+    return best
+
+
+def _resolve_kernel(
+    mod: ModuleInfo, scope: Optional[FunctionInfo], expr: ast.AST
+) -> Optional[FunctionInfo]:
+    """Peel the first argument of pallas_call down to a FunctionInfo:
+    a bare name, a ``functools.partial(fn, ...)`` call, or a local
+    ``kernel = partial(fn, ...)`` / ``kernel = other`` alias chain."""
+    for _ in range(8):  # alias/partial chains are short; bound the walk
+        if isinstance(expr, ast.Call) and last_attr(call_name(expr)) == "partial":
+            if not expr.args:
+                return None
+            expr = expr.args[0]
+            continue
+        if not isinstance(expr, ast.Name):
+            return None
+        name = expr.id
+        # a def in scope? prefer ones nested in the enclosing function
+        cands = mod.by_name.get(name, [])
+        if scope is not None:
+            nested = [c for c in cands if c.parent is scope]
+            if nested:
+                return nested[0]
+        if cands:
+            return cands[0]
+        # a local alias assignment inside the enclosing function?
+        if scope is None or isinstance(scope.node, ast.Lambda):
+            return None
+        assigned = None
+        for stmt in ast.walk(scope.node):
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == name
+            ):
+                assigned = stmt.value
+        if assigned is None:
+            return None
+        expr = assigned
+    return None
+
+
+@rule("G005")
+def check_pallas(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        is_pallas_module = os.path.basename(mod.relpath).startswith("pallas_")
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_attr(call_name(node)) != "pallas_call":
+                continue
+            scope = _enclosing(mod, node)
+            symbol = scope.qualname if scope else "<module>"
+            grid = get_arg(node, None, "grid")
+            grid_spec = get_arg(node, None, "grid_spec")
+            if grid is None and grid_spec is None:
+                findings.append(
+                    Finding(
+                        "G005",
+                        mod.relpath,
+                        node.lineno,
+                        node.col_offset,
+                        "pallas_call without an explicit grid= (or "
+                        "grid_spec=): the default single-block launch "
+                        "pulls the whole operand into VMEM",
+                        symbol,
+                    )
+                )
+            if grid_spec is None:
+                missing = [
+                    kw
+                    for kw in ("in_specs", "out_specs")
+                    if get_arg(node, None, kw) is None
+                ]
+                if missing:
+                    findings.append(
+                        Finding(
+                            "G005",
+                            mod.relpath,
+                            node.lineno,
+                            node.col_offset,
+                            f"pallas_call without explicit "
+                            f"{' and '.join(missing)}: default BlockSpecs "
+                            f"block the full operand shape; spell the "
+                            f"tiling (and memory spaces) out",
+                            symbol,
+                        )
+                    )
+
+            if not is_pallas_module or not node.args:
+                continue
+            kfi = _resolve_kernel(mod, scope, node.args[0])
+            if kfi is None or isinstance(kfi.node, ast.Lambda):
+                continue
+            if _uses_program_id(kfi.node) and not _has_bounding(kfi.node):
+                findings.append(
+                    Finding(
+                        "G005",
+                        mod.relpath,
+                        kfi.node.lineno,
+                        kfi.node.col_offset,
+                        f"kernel '{kfi.name}' derives indices from "
+                        f"pl.program_id but never bounds them "
+                        f"(jnp.minimum/maximum/clip/where, lax.min/max, "
+                        f"or pl.when); the padded last block will index "
+                        f"out of range",
+                        kfi.qualname,
+                    )
+                )
+    return findings

@@ -668,7 +668,11 @@ class GridRedistribute:
             exceed the per-axis subdomain width (one-hop shell).
           count: ``[R]`` valid-row counts (e.g. ``result.count``).
           headroom: multiplier for the derived capacities
-            (:func:`~.parallel.halo.default_capacities`).
+            (:func:`~.parallel.halo.default_capacities`). Note the
+            derivation sizes budgets from the PADDED per-shard rows
+            (``positions.shape[0] // R``), not the valid counts — a
+            mostly-padding buffer gets generous budgets, so forcing
+            overflow in tests needs ``headroom`` well below 1.
           pass_capacity / ghost_capacity: explicit capacity pins; by
             default sized from the halo-volume fraction, and GROWN on
             measured overflow under ``on_overflow='grow'`` (grown sizes
@@ -709,7 +713,7 @@ class GridRedistribute:
         pc = pass_capacity if pass_capacity is not None else max(dpc, grown_pc)
         gc = ghost_capacity if ghost_capacity is not None else max(dgc, grown_gc)
         max_attempts = 5
-        for _ in range(max_attempts):
+        for attempt in range(1, max_attempts + 1):
             result = self._halo_once(positions, fields, count, widths, pc, gc)
             if self.on_overflow == "ignore":
                 return result  # async preserved: no host sync on stats
@@ -728,22 +732,29 @@ class GridRedistribute:
                     f"halo overflow: {total_ov} ghosts dropped at the "
                     f"explicitly pinned capacities ({pc}, {gc})"
                 )
-            # grow: the overflow counter aggregates pass- and ghost-
-            # capacity drops (they cascade), so grow the ghost budget by
-            # the measured per-shard worst case and double the pass
-            # budget, bucketed to powers of two like redistribute.
+            if attempt == max_attempts:
+                # every grown capacity was actually run (growth below
+                # only happens when another attempt follows), so (pc, gc)
+                # here are the capacities of the run that still dropped.
+                raise RuntimeError(
+                    f"halo capacity growth did not converge in "
+                    f"{max_attempts} attempts (last run: "
+                    f"pass_capacity={pc}, ghost_capacity={gc}, "
+                    f"{total_ov} ghosts still dropped)"
+                )
+            # grow, then retry: the overflow counter aggregates pass- and
+            # ghost-capacity drops (they cascade), so grow both budgets
+            # by at least the measured per-shard worst case — doubling
+            # alone crawls when the starting budget is tiny relative to
+            # the need — bucketed to powers of two like redistribute.
             max_ov = int(overflow.max())
             if pass_capacity is None:
-                pc = _next_pow2(2 * pc)
+                pc = _next_pow2(max(2 * pc, pc + max_ov))
             if ghost_capacity is None:
                 gc = _next_pow2(gc + max_ov)
             self._halo_caps[widths] = (
                 max(pc, grown_pc), max(gc, grown_gc)
             )
-        raise RuntimeError(
-            f"halo capacity growth did not converge in {max_attempts} "
-            f"attempts (last: pass_capacity={pc}, ghost_capacity={gc})"
-        )
 
     def _halo_once(
         self, positions, fields, count, widths, pc: int, gc: int
@@ -855,10 +866,17 @@ class GridRedistribute:
         if self._pending_check is None:
             return
         counters, cap, out_cap, n_local, call_idx = self._pending_check
-        self._pending_check = None
-        self._resolved_through = max(self._resolved_through, call_idx)
+        # Blocking device reads FIRST, window bookkeeping after: if a
+        # read raises (backend/device failure), the window must stay
+        # pending so a later resolve or flush still surfaces the
+        # potential loss — clearing the snapshot before the reads
+        # succeeded would mark it resolved without ever looking at it.
         total_send = int(np.asarray(counters["dropped_send"]))
         total_recv = int(np.asarray(counters["dropped_recv"]))
+        needed = int(np.asarray(counters["needed_capacity"]))
+        needed_out = int(np.asarray(counters["needed_out"]))
+        self._pending_check = None
+        self._resolved_through = max(self._resolved_through, call_idx)
         dropped_send = total_send - self._seen_send
         dropped_recv = total_recv - self._seen_recv
         if not dropped_send and not dropped_recv:
@@ -866,8 +884,6 @@ class GridRedistribute:
         self._seen_send, self._seen_recv = total_send, total_recv
         # A drop this late cannot be healed (results already consumed):
         # grow for subsequent runs, then fail loudly — never silently.
-        needed = int(np.asarray(counters["needed_capacity"]))
-        needed_out = int(np.asarray(counters["needed_out"]))
         self._grow(
             dropped_send, dropped_recv, needed, needed_out, n_local,
             cap, out_cap,

@@ -1,0 +1,103 @@
+"""Version compatibility shims.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace around jax 0.4.35 / 0.5; the experimental module was
+dropped later. Import it from here so the whole package tracks either
+location with one line of fallback.
+"""
+
+from __future__ import annotations
+
+import types
+
+import jax
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.5)
+except ImportError:  # pragma: no cover - exercised only on old jax
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    @functools.wraps(_shard_map_old)
+    def shard_map(*args, **kwargs):
+        """Old-jax shard_map with its replication checker off.
+
+        This package types collective results with the NEW vma system
+        (lax.pvary / pcast promotions — no-ops here, see below); the old
+        tracer-side check_rep infers different replication sets for
+        scan carries built from those results and rejects valid
+        programs, so it cannot be satisfied from this codebase.
+        """
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_old(*args, **kwargs)
+
+
+# --- varying-mesh-axes (vma) typing -----------------------------------
+# Newer jax types shard_map-internal values with the set of mesh axes
+# they vary over (``jax.typeof(x).vma``) and requires pallas_call
+# operands/outputs to agree; older jax has no such typing, so the
+# promotion helpers degrade to no-ops there.
+
+_EMPTY_VMA_AVAL = types.SimpleNamespace(vma=frozenset())
+
+if hasattr(jax, "typeof"):
+    typeof = jax.typeof
+else:  # pragma: no cover - exercised only on old jax
+
+    def typeof(x):
+        """Old-jax stand-in: no vma typing, every value reads as unvaried."""
+        return _EMPTY_VMA_AVAL
+
+
+def pvary(x, axes):
+    """``jax.lax.pvary`` where it exists, identity where vma typing
+    predates it (nothing to promote)."""
+    if not axes:
+        return x
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axes))
+    return x
+
+
+def pcast_varying(x, axes):
+    """``lax.pcast(x, axes, to='varying')`` on new jax, identity on old."""
+    if not axes:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axes), to="varying")
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover - mid-window jax
+        return jax.lax.pvary(x, tuple(axes))
+    return x
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` that forwards ``vma`` only where the
+    constructor accepts it."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # pragma: no cover - old jax
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """TPU pallas compiler params across the CompilerParams /
+    TPUCompilerParams rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)
+
+
+__all__ = [
+    "shard_map",
+    "typeof",
+    "pvary",
+    "pcast_varying",
+    "shape_dtype_struct",
+    "tpu_compiler_params",
+]

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from mpi_grid_redistribute_tpu import compat
+from mpi_grid_redistribute_tpu.compat import shard_map
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import (
@@ -414,8 +415,10 @@ def make_migrate_loop(
         # scan requires carry leaves already marked device-varying (some
         # init_state outputs are iota-derived and start unvaried)
         def _vary(x):
-            missing = tuple(a for a in axes if a not in jax.typeof(x).vma)
-            return lax.pcast(x, missing, to="varying") if missing else x
+            missing = tuple(
+                a for a in axes if a not in compat.typeof(x).vma
+            )
+            return compat.pcast_varying(x, missing) if missing else x
 
         state = jax.tree.map(_vary, state)
 

@@ -476,10 +476,12 @@ def match_vma(x, ref):
     Pallas kernels under shard_map want every input carrying the same
     varying-axes set; a mismatched scalar-prep array can make tracing
     insert ``pvary`` inside the kernel jaxpr, which Mosaic rejects."""
+    from mpi_grid_redistribute_tpu import compat
+
     want = tuple(
-        a for a in jax.typeof(ref).vma if a not in jax.typeof(x).vma
+        a for a in compat.typeof(ref).vma if a not in compat.typeof(x).vma
     )
-    return jax.lax.pvary(x, want) if want else x
+    return compat.pvary(x, want) if want else x
 
 
 def dest_histogram(dest, nranks: int, valid=None):

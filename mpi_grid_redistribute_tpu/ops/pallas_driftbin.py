@@ -42,6 +42,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi_grid_redistribute_tpu import compat
+
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
 
@@ -51,7 +53,7 @@ from mpi_grid_redistribute_tpu.ops import binning
 _WIDTHS = (32768, 16384, 8192, 4096, 2048, 1024)
 
 
-def _axis_consts(domain: Domain, grid_shape, d):
+def _axis_consts(domain: Domain, grid_shape, d: int):
     """Per-axis f32 constants, computed with numpy f32 arithmetic so the
     bits match XLA's constant folding of the engine's jnp expressions."""
     lo = np.float32(domain.lo[d])
@@ -133,7 +135,7 @@ def _driftbin_call(flat, *, V, n, w, K, D, dt, consts, periodic, shape,
         shape=shape, strides=strides, R_total=R_total,
     )
     nblk = n // w
-    vma = jax.typeof(flat).vma
+    vma = compat.typeof(flat).vma
     return pl.pallas_call(
         kernel,
         grid=(nblk, V),
@@ -152,8 +154,8 @@ def _driftbin_call(flat, *, V, n, w, K, D, dt, consts, periodic, shape,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((K, V * n), flat.dtype, vma=vma),
-            jax.ShapeDtypeStruct((V, n), jnp.int32, vma=vma),
+            compat.shape_dtype_struct((K, V * n), flat.dtype, vma=vma),
+            compat.shape_dtype_struct((V, n), jnp.int32, vma=vma),
         ],
         # the pre-drift state is dead once streamed: update in place
         input_output_aliases={0: 0},
@@ -227,7 +229,8 @@ def supports(domain: Domain, V: int, n: int, K: int,
     )
 
 
-def drift_wrap_bin(flat, dt, domain: Domain, full_grid: ProcessGrid,
+def drift_wrap_bin(flat, dt: float, domain: Domain,
+                   full_grid: ProcessGrid,
                    V: int, R_total: int, interpret=False, w=None):
     """Fused drift + wrap + bin: ``[K, V*n]`` int32 planar state ->
     ``(drifted state, dest_key [V, n])``, one streaming pass.

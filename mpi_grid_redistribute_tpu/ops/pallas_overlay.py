@@ -61,6 +61,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi_grid_redistribute_tpu import compat
+
 from mpi_grid_redistribute_tpu.ops import binning
 
 W = 2048  # baseline lane-block width; `overlay_scatter_planar` upgrades
@@ -233,8 +235,8 @@ def _overlay_sorted(flat, starts, planes, interpret=False, w=W, rmax=RMAX,
                                memory_space=pltpu.VMEM),
         # under shard_map the output must declare its varying mesh axes;
         # mirror the input state's vma (empty outside shard_map)
-        out_shape=jax.ShapeDtypeStruct(
-            (k, m), flat.dtype, vma=jax.typeof(flat).vma
+        out_shape=compat.shape_dtype_struct(
+            (k, m), flat.dtype, vma=compat.typeof(flat).vma
         ),
         scratch_shapes=[
             pltpu.VMEM((2, rows, rmax), jnp.float32),  # 2 chunk buffers
@@ -331,8 +333,8 @@ def _overlay_sorted_i8(flat, starts, planes8, tgts, interpret=False, w=W,
         ],
         out_specs=pl.BlockSpec((k, w), lambda b: (0, b),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (k, m), flat.dtype, vma=jax.typeof(flat).vma
+        out_shape=compat.shape_dtype_struct(
+            (k, m), flat.dtype, vma=compat.typeof(flat).vma
         ),
         scratch_shapes=[
             pltpu.VMEM((2, rows8, rmax), jnp.int8),  # 2 chunk buffers

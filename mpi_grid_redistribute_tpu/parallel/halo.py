@@ -58,7 +58,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from mpi_grid_redistribute_tpu.compat import shard_map
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops.pack import _stable_order, _take_rows, _mask_rows
@@ -108,6 +108,15 @@ def default_capacities(
     headroom: float = 2.0,
 ) -> Tuple[int, int]:
     """Derived ``(pass_capacity, ghost_capacity)`` for near-uniform density.
+
+    ``n_local`` is the PADDED per-shard row count (``positions.shape[0]
+    // R`` — the static buffer size every shard carries), not the valid
+    count: capacities must hold whatever the buffers could contain, and
+    valid counts are per-shard device values unknown when the static
+    program is built. With the default ``headroom=2.0`` the budgets are
+    therefore conservative for buffers that are mostly padding — a shard
+    whose valid rows are a small fraction of ``n_local`` still gets
+    capacities sized from the full padded buffer.
 
     Per axis the face-shell fraction is ``f_a = w_a / cell_w_a`` per
     direction; a pass along axis ``a`` selects from own rows plus ghosts

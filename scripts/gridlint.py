@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Run gridlint, the repo's AST-based SPMD/JIT invariant checker.
+
+Usage:
+    python scripts/gridlint.py [paths...] [--format=json] [--check]
+    python scripts/gridlint.py --list-rules
+
+See mpi_grid_redistribute_tpu/analysis/__init__.py for the rule table
+(G001-G005), suppression syntax, and baseline semantics. The analysis
+itself is pure-stdlib ``ast`` work; nothing it scans is executed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_grid_redistribute_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,527 @@
+"""gridlint (mpi_grid_redistribute_tpu.analysis) — rule fixtures + repo gate.
+
+Each rule gets at least one fixture that must FIRE and one that must
+stay QUIET; the final test runs the real package through the linter
+against the committed baseline and requires zero non-baselined
+findings — the tier-1 gate the CLI (`make lint`) also enforces.
+
+Pure AST work: nothing here imports jax or executes fixture code.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from mpi_grid_redistribute_tpu.analysis.baseline import (
+    default_baseline_path,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from mpi_grid_redistribute_tpu.analysis.cli import main as cli_main
+from mpi_grid_redistribute_tpu.analysis.core import RULE_IDS, run_gridlint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "mpi_grid_redistribute_tpu")
+
+
+def lint(tmp_path, files, rules=None):
+    """Write ``files`` (name -> source) under tmp_path and lint them."""
+    for name, src in files.items():
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return run_gridlint([str(tmp_path)], root=str(tmp_path), rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------- G001
+
+
+_G001_PREAMBLE = """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh
+    from mpi_grid_redistribute_tpu.compat import shard_map
+
+    mesh = Mesh(jax.devices(), axis_names=("shards",))
+"""
+
+
+def test_g001_fires_on_data_dependent_collective(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": _G001_PREAMBLE
+            + """
+    def body(x, count):
+        if count > 0:
+            x = lax.psum(x, axis_name="shards")
+        return x
+
+    fn = shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G001"], findings
+    assert "data-dependent" in findings[0].message
+
+
+def test_g001_quiet_on_unconditional_collective(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": _G001_PREAMBLE
+            + """
+    def body(x, count):
+        # trace-time host branch on config is fine
+        if x.ndim == 2:
+            x = x + 1
+        return lax.psum(x, axis_name="shards")
+
+    fn = shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+def test_g001_fires_inside_cond_branch_and_try(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": _G001_PREAMBLE
+            + """
+    def body(x, flag):
+        def hot(y):
+            return lax.psum(y, axis_name="shards")
+
+        def cold(y):
+            return y
+
+        try:
+            z = lax.ppermute(x, "shards", [(0, 1)])
+        except ValueError:
+            z = x
+        return lax.cond(flag, hot, cold, z)
+
+    fn = shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+    """,
+        },
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert "branch function" in msgs
+    assert "try block" in msgs
+
+
+def test_g001_fires_on_undeclared_axis_name(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": _G001_PREAMBLE
+            + """
+    def body(x):
+        return lax.psum(x, axis_name="shrads")  # typo'd axis
+
+    fn = shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G001"], findings
+    assert "shrads" in findings[0].message
+
+
+# ---------------------------------------------------------------- G002
+
+
+def test_g002_fires_on_host_syncs_in_jitted_code(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        n = int(x)            # host sync
+        y = np.asarray(x)     # device->host copy
+        return x.item() + n + y.sum()
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G002"]
+    assert len(findings) == 3, findings
+
+
+def test_g002_quiet_on_static_annotated_params_and_host_fns(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x, n_steps: int, scale: float):
+        # int()/float() on annotated config params is trace-time math
+        return x * float(scale) * int(n_steps)
+
+    def host_only(x):
+        # not jit-reachable: host syncs are fine here
+        return float(np.asarray(x).sum())
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+def test_g002_reaches_through_builders_and_helpers(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+
+    def helper(x):
+        return x.item()  # reached transitively from the jit root
+
+    def build():
+        def call(x):
+            return helper(x)
+
+        return jax.jit(call)
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G002"]
+    assert findings[0].symbol == "helper"
+
+
+# ---------------------------------------------------------------- G003
+
+
+def test_g003_fires_on_dynamic_shapes(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pick(x):
+        idx = jnp.nonzero(x > 0)          # unsized
+        hits = jnp.where(x > 1)           # 1-arg nonzero form
+        return x[x > 0], idx, hits        # boolean-mask indexing
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G003"]
+    assert len(findings) == 3, findings
+
+
+def test_g003_quiet_on_sized_and_select_forms(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pick(x, cap: int):
+        idx = jnp.nonzero(x > 0, size=cap, fill_value=0)
+        sel = jnp.where(x > 1, x, 0)
+        return idx, sel
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+# ---------------------------------------------------------------- G004
+
+
+def test_g004_fires_on_unguarded_fuse(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    from pack import fuse_fields
+
+    def ship(positions, fields):
+        return fuse_fields(positions, fields)
+    """,
+            "pack.py": """
+    def fuse_fields(positions, fields):
+        return positions
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G004"], findings
+
+
+def test_g004_quiet_when_guard_in_callee_or_caller(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    def fuse_fields(positions, fields):
+        # self-guarding fuse (migrate.fuse_fields shape)
+        if positions.dtype.itemsize != 4:
+            raise TypeError("planar path needs 32-bit rows")
+        return positions
+
+    def specs_of(a):
+        if a.dtype.itemsize != 4:
+            return None
+        return a.shape
+
+    def build(specs):
+        def call(positions, fields):
+            return fuse_fields(positions, fields)
+
+        return call
+
+    def entry(positions, fields):
+        # one-frame-up guard: entry consults the itemsize helper
+        specs = specs_of(positions)
+        if specs is None:
+            return positions
+        return build(specs)(positions, fields)
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+# ---------------------------------------------------------------- G005
+
+
+def test_g005_fires_on_defaulted_pallas_call(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    from jax.experimental import pallas as pl
+
+    def launch(kernel, x):
+        return pl.pallas_call(kernel, out_shape=x)(x)
+    """,
+        },
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert rules_of(findings) == ["G005"]
+    assert "grid" in msgs and "in_specs" in msgs
+
+
+def test_g005_fires_on_unbounded_program_id_kernel(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "pallas_fix.py": """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def _kernel(in_ref, out_ref):
+        b = pl.program_id(0)
+        out_ref[b] = in_ref[b] + 1  # no bound: last padded block escapes
+
+    def launch(x, grid, in_specs, out_specs):
+        return pl.pallas_call(
+            _kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=x,
+        )(x)
+    """,
+        },
+    )
+    assert rules_of(findings) == ["G005"], findings
+    assert "program_id" in findings[0].message
+
+
+def test_g005_quiet_on_bounded_partial_wrapped_kernel(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "pallas_fix.py": """
+    import functools
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def _kernel(in_ref, out_ref, *, n):
+        b = pl.program_id(0)
+        i = jnp.minimum(b, n - 1)
+        out_ref[i] = in_ref[i] + 1
+
+    def launch(x, n, grid, in_specs, out_specs):
+        kernel = functools.partial(_kernel, n=n)
+        return pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=x,
+        )(x)
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+# ------------------------------------------------- suppressions, baseline
+
+
+def test_inline_and_file_suppressions(tmp_path):
+    files = {
+        "mod.py": """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pick(x):
+        return jnp.nonzero(x > 0)  # gridlint: disable=G003
+    """,
+        "legacy.py": """
+    # gridlint: disable-file=G003
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def old(x):
+        return jnp.nonzero(x < 0)
+    """,
+    }
+    assert lint(tmp_path, files) == []
+    # same fixtures without the pragmas do fire
+    stripped = {
+        k: v.replace("# gridlint: disable=G003", "").replace(
+            "# gridlint: disable-file=G003", ""
+        )
+        for k, v in files.items()
+    }
+    assert rules_of(lint(tmp_path, stripped)) == ["G003"]
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pick(x):
+        return jnp.nonzero(x > 0)
+    """,
+        },
+    )
+    assert len(findings) == 1
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, findings, justification="fixture")
+    baseline = load_baseline(bl_path)
+    new, old = split_baselined(findings, baseline)
+    assert new == [] and len(old) == 1
+    # entries carry the justification
+    payload = json.loads(open(bl_path).read())
+    assert payload["findings"][0]["justification"] == "fixture"
+    # a key nothing matches is stale
+    stale_keys = baseline - {f.baseline_key() for f in old}
+    assert stale_keys == set()
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def pick(x):
+                return jnp.nonzero(x > 0)
+            """
+        )
+    )
+    rc = cli_main(
+        [
+            str(tmp_path / "mod.py"),
+            "--root",
+            str(tmp_path),
+            "--no-baseline",
+            "--format",
+            "json",
+        ]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in out["findings"]] == ["G003"]
+    # --write-baseline then a clean --check round-trip
+    bl = str(tmp_path / "bl.json")
+    assert (
+        cli_main(
+            [
+                str(tmp_path / "mod.py"),
+                "--root",
+                str(tmp_path),
+                "--baseline",
+                bl,
+                "--write-baseline",
+            ]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    assert (
+        cli_main(
+            [
+                str(tmp_path / "mod.py"),
+                "--root",
+                str(tmp_path),
+                "--baseline",
+                bl,
+                "--check",
+            ]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    assert cli_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    assert all(rid in listed for rid in RULE_IDS)
+
+
+# ------------------------------------------------------- the repo gate
+
+
+def test_package_is_gridlint_clean_against_baseline():
+    """The tier-1 gate: zero non-baselined findings over the package."""
+    findings = run_gridlint([PACKAGE], root=REPO_ROOT)
+    baseline = load_baseline(default_baseline_path())
+    new, _ = split_baselined(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_baseline_has_no_stale_entries():
+    findings = run_gridlint([PACKAGE], root=REPO_ROOT)
+    baseline = load_baseline(default_baseline_path())
+    _, old = split_baselined(findings, baseline)
+    stale = baseline - {f.baseline_key() for f in old}
+    assert stale == set(), stale
+
+
+def test_cli_script_entry_point():
+    """scripts/gridlint.py is runnable and exits 0 on the package."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "gridlint.py"),
+         "mpi_grid_redistribute_tpu/", "--check"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

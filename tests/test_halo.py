@@ -465,8 +465,12 @@ def test_api_halo_fields_and_engine_parity(rng):
 
 
 def test_api_halo_grow_on_overflow(rng):
-    """Clustered data overflowing the derived capacities is healed by
-    growth under on_overflow='grow'; grown capacities stick per width."""
+    """Data overflowing the derived capacities is healed by growth under
+    on_overflow='grow'; grown capacities stick per width.
+
+    The derived budgets are sized from the PADDED per-shard rows (see
+    default_capacities), so even headroom=1.0 is generous for clustered
+    inputs — forcing real overflow needs headroom well below 1."""
     domain = Domain(0.0, 1.0, periodic=True)
     grid = ProcessGrid((2, 2, 2))
     R, n_local = 8, 256
@@ -475,15 +479,86 @@ def test_api_halo_grow_on_overflow(rng):
     rd = GridRedistribute(domain, grid, capacity_factor=8.0,
                           out_capacity=8 * n_local)
     res = rd.redistribute(pos)
+    # establish that these inputs genuinely overflow the starved budgets
+    # before claiming growth healed anything
+    rd_probe = GridRedistribute(domain, grid, on_overflow="ignore")
+    probe = rd_probe.halo(res.positions, width=0.12, count=res.count,
+                          headroom=0.05)
+    assert int(np.asarray(probe.overflow).sum()) > 0
     hres = rd.halo(res.positions, width=0.12, count=res.count,
-                   headroom=1.0)
+                   headroom=0.05)
     assert int(np.asarray(hres.overflow).sum()) == 0
     assert rd._halo_caps  # growth stuck on the instance
+    # the stuck capacities exceed the starved derived ones
+    widths = halo_lib._as_per_axis(0.12, domain.ndim)
+    dpc, dgc = halo_lib.default_capacities(
+        domain, grid, widths, res.positions.shape[0] // R, 0.05
+    )
+    spc, sgc = rd._halo_caps[widths]
+    assert spc >= dpc and sgc >= dgc and (spc, sgc) != (dpc, dgc)
     # 'raise' surfaces instead of healing
     rd2 = GridRedistribute(domain, grid, on_overflow="raise")
     with pytest.raises(RuntimeError, match="halo overflow"):
         rd2.halo(res.positions, width=0.12, count=res.count,
                  headroom=0.05)
+
+
+def test_api_halo_grow_retries_with_grown_caps(rng):
+    """Regression for the grow-then-retry restructure: every capacity
+    pair the loop grows to is actually RUN (growth only happens when a
+    retry follows), capacities increase monotonically, and the run that
+    returns is the last attempted pair."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 256
+    pos = (rng.uniform(0, 1, size=(R * n_local, 3)) ** 4).astype(np.float32)
+    rd = GridRedistribute(domain, grid, capacity_factor=8.0,
+                          out_capacity=8 * n_local)
+    res = rd.redistribute(pos)
+    attempts = []
+    real_once = rd._halo_once
+
+    def spy(positions, fields, count, widths, pc, gc):
+        attempts.append((pc, gc))
+        return real_once(positions, fields, count, widths, pc, gc)
+
+    rd._halo_once = spy
+    hres = rd.halo(res.positions, width=0.12, count=res.count,
+                   headroom=0.05)
+    assert int(np.asarray(hres.overflow).sum()) == 0
+    assert len(attempts) >= 2  # starved start forced at least one retry
+    for (pc0, gc0), (pc1, gc1) in zip(attempts, attempts[1:]):
+        assert pc1 >= pc0 and gc1 >= gc0 and (pc1, gc1) != (pc0, gc0)
+    # the capacities that stuck are the ones of the final successful run
+    widths = halo_lib._as_per_axis(0.12, domain.ndim)
+    assert rd._halo_caps[widths] == attempts[-1]
+
+
+def test_api_halo_grow_nonconvergence_reports_run_caps(rng):
+    """When growth never converges, the error names the capacities of
+    the run that still overflowed — not untried next-round values."""
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    R, n_local = 8, 64
+    pos = rng.uniform(0, 1, size=(R * n_local, 3)).astype(np.float32)
+    rd = GridRedistribute(domain, grid)
+    res = rd.redistribute(pos)
+    attempts = []
+
+    def always_overflow(positions, fields, count, widths, pc, gc):
+        attempts.append((pc, gc))
+        return halo_lib.HaloResult(
+            positions, np.zeros(R, np.int32), (), np.ones(R, np.int32)
+        )
+
+    rd._halo_once = always_overflow
+    with pytest.raises(RuntimeError, match="did not converge") as ei:
+        rd.halo(res.positions, width=0.1, count=res.count)
+    assert len(attempts) == 5  # max_attempts runs, all attempted
+    last_pc, last_gc = attempts[-1]
+    msg = str(ei.value)
+    assert f"pass_capacity={last_pc}" in msg
+    assert f"ghost_capacity={last_gc}" in msg
 
 
 def test_api_halo_validation(rng):
