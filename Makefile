@@ -1,9 +1,15 @@
 PY ?= python
 
-.PHONY: test lint lint-json baseline
+.PHONY: test lint lint-json baseline bench-check
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# regression guard: newest BENCH_r*.json capture vs the BEST committed
+# history per guarded metric (value, ms_per_step, exchange_bytes_per_sec);
+# >10% worse on any = exit 1. See mpi_grid_redistribute_tpu/telemetry/regress.py.
+bench-check:
+	$(PY) scripts/bench_check.py
 
 # gridlint: AST-based SPMD/JIT invariant checker (G001-G005).
 # Exit 0 = clean or fully baselined; 1 = new findings or stale baseline

@@ -24,10 +24,12 @@ has ~100 ms fixed round-trip latency that would otherwise swamp the signal).
 Env overrides: BENCH_N_LOCAL (particles per subdomain), BENCH_MIGRATION
 (target per-step migration fraction, default 0.02 — a
 generous rate for drift steps, which move particles well under a cell width), BENCH_S1/BENCH_S2
-(loop lengths), BENCH_BASELINE_N (CPU-oracle total particles),
-BENCH_GRID (comma grid shape, default "2,2,2" — "4,4,4" with the default
-n_local is the BASELINE north-star 64M-particle workload, run as 64
-vranks on one chip when fewer devices exist).
+(loop lengths), BENCH_BASELINE_N (CPU-oracle total particles; defaults to
+the device run's total so numerator and denominator price the same
+population), BENCH_GRID (comma grid shape, default "2,2,2" — "4,4,4" with
+the default n_local is the BASELINE north-star 64M-particle workload, run
+as 64 vranks on one chip when fewer devices exist), BENCH_STRESS (0
+disables the full-reshuffle stress capture appended under "stress").
 """
 
 from __future__ import annotations
@@ -109,12 +111,16 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     from mpi_grid_redistribute_tpu.utils import profiling
 
     t0 = time.perf_counter()
-    per_step, _overhead, long_out = profiling.scan_time_per_step(
+    # min-of-k protocol (telemetry.regress): k independent long-loop runs
+    # give per-step samples; min is the estimate, spread the noise floor
+    detail, long_out = profiling.scan_time_per_step_samples(
         lambda S: nbody.make_migrate_loop(cfg, mesh, S, vgrid=vgrid),
         (pos, vel, alive),
         s1=s1,
         s2=s2,
+        reps=int(os.environ.get("BENCH_REPS", 4)),
     )
+    per_step = detail["min"]
     c1 = time.perf_counter() - t0  # includes both compiles
     stats = long_out[3]
     sent = np.asarray(stats.sent).sum(axis=1)
@@ -135,13 +141,15 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
         + f", n/slab={n_local}, cap/pair={cap}, first compile {c1:.0f}s"
     )
     _stderr(
-        f"  per-step {per_step*1e3:.2f} ms; migration/step "
+        f"  per-step {per_step*1e3:.2f} ms (spread "
+        f"{detail['spread']*100:.1f}% over k={detail['k']}); "
+        f"migration/step "
         f"{sent.mean()/total:.3%} (backlog {backlog}, dropped {dropped}); "
         f"exchange {xbytes/1e6:.2f} MB/step ({xdomain})"
     )
     if dropped:
         _stderr("  WARNING: arrivals dropped — raise slab headroom")
-    return total / per_step, n_chips, xbytes, xdomain, per_step
+    return total / per_step, n_chips, xbytes, xdomain, per_step, detail
 
 
 def time_cpu_oracle(n_total: int, migration: float, n_steps: int = 5,
@@ -197,9 +205,13 @@ def main() -> None:
     migration = float(os.environ.get("BENCH_MIGRATION", 0.02))
     s1 = int(os.environ.get("BENCH_S1", 8))
     s2 = int(os.environ.get("BENCH_S2", 72))
-    baseline_n = int(os.environ.get("BENCH_BASELINE_N", 2**21))
+    # default the CPU comparator to the DEVICE run's population, so
+    # vs_baseline divides throughputs over the same workload (the old
+    # fixed 2**21 silently compared different populations whenever
+    # BENCH_N_LOCAL changed)
+    baseline_n = int(os.environ.get("BENCH_BASELINE_N", R * n_local))
 
-    pps, n_chips, xbytes, xdomain, per_step = time_device_pipeline(
+    pps, n_chips, xbytes, xdomain, per_step, detail = time_device_pipeline(
         n_local, migration, s1, s2
     )
     pps_per_chip = pps / n_chips
@@ -220,6 +232,16 @@ def main() -> None:
         f"{cpu_native_pps:.3e} particles/s"
     )
 
+    # full-reshuffle stress capture (bench/config7_stress.py): what
+    # utilization the exchange reaches when ~every row moves every step —
+    # the drift loop above is compute-bound at 2% migration, so its
+    # bw_util says nothing about the exchange's own roof-side headroom
+    stress = None
+    if os.environ.get("BENCH_STRESS", "1") != "0":
+        from mpi_grid_redistribute_tpu.bench import config7_stress
+
+        stress = config7_stress.run()
+
     print(
         json.dumps(
             {
@@ -228,7 +250,18 @@ def main() -> None:
                 "unit": "particles/s",
                 "vs_baseline": round(pps / cpu_pps, 3),
                 "vs_our_native_cpu": round(pps / cpu_native_pps, 3),
+                # comparator provenance: the population both CPU rates
+                # timed, and the rates themselves, so vs_* is reproducible
+                # from the capture alone
+                "baseline_n": baseline_n,
+                "cpu_pps": round(cpu_pps, 2),
+                "cpu_native_pps": round(cpu_native_pps, 2),
                 "ms_per_step": round(per_step * 1e3, 3),
+                # min-of-k noise floor: (max-min)/min over k long-loop
+                # runs (telemetry.regress protocol) — a capture whose
+                # spread rivals the 10% regression threshold is suspect
+                "timing_spread": round(detail["spread"], 4),
+                "timing_k": detail["k"],
                 # BASELINE metric's second half: exchange bandwidth. On a
                 # single chip the vrank exchange never leaves HBM
                 # (exchange_domain = "hbm"); on >=8 chips the same rows
@@ -247,6 +280,7 @@ def main() -> None:
                     ),
                     6,
                 ),
+                "stress": stress,
             }
         )
     )

@@ -93,6 +93,12 @@ def main() -> None:
     print(f"moved {summary['moved_rows']:.0f} rows total; "
           f"recv imbalance {summary['recv_imbalance']:.3f}; "
           f"dropped {summary['dropped_send'] + summary['dropped_recv']}")
+    # resolve the deferred overflow window here (one device fetch at a
+    # known point) rather than warning from __del__ at teardown, and show
+    # the merged telemetry surface while we are at it
+    rd.flush_overflow_checks()
+    from mpi_grid_redistribute_tpu.telemetry import report as report_lib
+    print("telemetry: " + report_lib.format_report(rd.report()))
 
     # --- 2. drift loop: redistribute every step (SURVEY.md §3.3) --------
     dev_grid, vgrid, mesh, n_chips = common.pick_layout(grid_shape)

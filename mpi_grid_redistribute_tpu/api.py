@@ -33,6 +33,8 @@ from mpi_grid_redistribute_tpu import oracle
 from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
 from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
 from mpi_grid_redistribute_tpu.parallel.halo import HaloResult
+from mpi_grid_redistribute_tpu.telemetry import recorder as telemetry_lib
+from mpi_grid_redistribute_tpu.telemetry import report as report_lib
 
 
 class RedistributeResult(NamedTuple):
@@ -398,6 +400,14 @@ class GridRedistribute:
         self._del_warned = False  # __del__ warns at most once
         self._last_caps = None  # (cap, out_cap, n_local) of the last call
         self._halo_caps = {}  # widths tuple -> grown (pass_cap, ghost_cap)
+        # Telemetry journal (telemetry/recorder.py): every capacity
+        # growth, deferred-window transition and call lands here as a
+        # host-side event — recording never syncs the device, same
+        # contract as the deferred checks above. `rd.report()` reads the
+        # last call's stats plus these counts into one metrics dict.
+        self.telemetry = telemetry_lib.StepRecorder()
+        self._last_stats = None
+        self._last_row_bytes = None
         self.capacity = capacity
         self.capacity_factor = float(capacity_factor)
         self.out_capacity = out_capacity
@@ -582,10 +592,19 @@ class GridRedistribute:
             positions, fields, count
         )
         self._call_index += 1
+        self._last_row_bytes = report_lib.row_bytes_of(positions, *fields)
         max_attempts = 5
         for _ in range(max_attempts):
             cap, out_cap = self._capacities(n_local)
             result = self._run_once(positions, fields, count, cap, out_cap)
+            self._last_stats = result.stats
+            self.telemetry.record(
+                "redistribute",
+                call=self._call_index,
+                n_local=n_local,
+                capacity=cap,
+                out_capacity=out_cap,
+            )
             if self.on_overflow == "ignore":
                 return result  # async preserved: no host sync on stats
             if (
@@ -715,6 +734,12 @@ class GridRedistribute:
         max_attempts = 5
         for attempt in range(1, max_attempts + 1):
             result = self._halo_once(positions, fields, count, widths, pc, gc)
+            self.telemetry.record(
+                "halo",
+                n_local=n_local,
+                pass_capacity=pc,
+                ghost_capacity=gc,
+            )
             if self.on_overflow == "ignore":
                 return result  # async preserved: no host sync on stats
             overflow = np.asarray(result.overflow)
@@ -748,12 +773,21 @@ class GridRedistribute:
             # alone crawls when the starting budget is tiny relative to
             # the need — bucketed to powers of two like redistribute.
             max_ov = int(overflow.max())
+            old_pc, old_gc = pc, gc
             if pass_capacity is None:
                 pc = _next_pow2(max(2 * pc, pc + max_ov))
             if ghost_capacity is None:
                 gc = _next_pow2(gc + max_ov)
             self._halo_caps[widths] = (
                 max(pc, grown_pc), max(gc, grown_gc)
+            )
+            self.telemetry.record(
+                "halo_grow",
+                old_pass_capacity=old_pc,
+                new_pass_capacity=pc,
+                old_ghost_capacity=old_gc,
+                new_ghost_capacity=gc,
+                overflow=total_ov,
             )
 
     def _halo_once(
@@ -831,6 +865,15 @@ class GridRedistribute:
                 floor = last_cap if self.capacity is None else self.capacity
                 self.capacity = max(new_cap, floor)
                 grew = True
+                self.telemetry.record(
+                    "capacity_grow",
+                    which="send",
+                    old=cap,
+                    new=self.capacity,
+                    needed=needed,
+                    dropped=dropped_send,
+                    call=self._call_index,
+                )
         if dropped_recv:
             new_out = min(_next_pow2(needed_out), self.nranks * n_local)
             if new_out > out_cap:
@@ -840,6 +883,15 @@ class GridRedistribute:
                 )
                 self.out_capacity = max(new_out, floor)
                 grew = True
+                self.telemetry.record(
+                    "capacity_grow",
+                    which="recv",
+                    old=out_cap,
+                    new=self.out_capacity,
+                    needed=needed_out,
+                    dropped=dropped_recv,
+                    call=self._call_index,
+                )
         return grew
 
     def _deferred_check(self, n_local, cap, out_cap) -> None:
@@ -861,6 +913,11 @@ class GridRedistribute:
         self._pending_check = (
             counters, cap, out_cap, n_local, self._call_index
         )
+        self.telemetry.record(
+            "overflow_window_scheduled",
+            through_call=self._call_index,
+            window=self.check_every,
+        )
 
     def _resolve_pending(self) -> None:
         if self._pending_check is None:
@@ -880,8 +937,17 @@ class GridRedistribute:
         dropped_send = total_send - self._seen_send
         dropped_recv = total_recv - self._seen_recv
         if not dropped_send and not dropped_recv:
+            self.telemetry.record(
+                "overflow_window_clean", through_call=call_idx
+            )
             return
         self._seen_send, self._seen_recv = total_send, total_recv
+        self.telemetry.record(
+            "overflow_window_loss",
+            through_call=call_idx,
+            dropped_send=dropped_send,
+            dropped_recv=dropped_recv,
+        )
         # A drop this late cannot be healed (results already consumed):
         # grow for subsequent runs, then fail loudly — never silently.
         self._grow(
@@ -983,6 +1049,54 @@ class GridRedistribute:
             )
             self._calls_since_check = 0
         self._resolve_pending()
+
+    def _exchange_topology(self) -> Tuple[str, int]:
+        """(domain, n_chips) of the exchange this instance dispatches:
+        ``("hbm", 1)`` when the R-rank grid runs on one chip (vranks, or
+        a single-device mesh — its "wire" is HBM-side gathers/scatters;
+        the numpy oracle reports the same for schema stability), and
+        ``("ici", n_devices)`` when rows ride the inter-chip all_to_all."""
+        if self.backend != "jax" or self._vranks:
+            return "hbm", 1
+        n = int(self.mesh.devices.size)
+        return ("ici", n) if n > 1 else ("hbm", 1)
+
+    def report(self, step_seconds: Optional[float] = None) -> dict:
+        """The instance's metrics surface: one merged, JSON-serializable
+        dict (:func:`~.telemetry.report.exchange_report`) from the LAST
+        redistribute call's stats — summary counters, exchange bytes per
+        step (total and moved), and — when ``step_seconds`` is given —
+        achieved GB/s plus ``bw_util`` against this instance's domain
+        roof (HBM for single-chip vrank exchanges, summed ICI links per
+        chip for multi-chip meshes), plus the telemetry journal's
+        all-time event counts and the instance capacities.
+
+        NOTE this fetches the last stats pytree to the host (tiny, but a
+        sync): call it at loop/bench boundaries, not per step. Pass a
+        scan-differenced ``step_seconds``
+        (:func:`~.utils.profiling.scan_time_per_step`) for honest rates —
+        wall-clock would bill dispatch overhead as wire time, so without
+        it the rate/utilization fields stay ``None``.
+        """
+        if self._last_stats is None:
+            raise RuntimeError(
+                "report() needs at least one redistribute() call"
+            )
+        domain, n_chips = self._exchange_topology()
+        out = report_lib.exchange_report(
+            self._last_stats,
+            self._last_row_bytes,
+            step_seconds=step_seconds,
+            domain=domain,
+            n_chips=n_chips,
+            recorder=self.telemetry,
+        )
+        out["calls"] = self._call_index
+        out["capacity"] = self.capacity
+        out["out_capacity"] = self.out_capacity
+        out["blocking_fetches"] = self._blocking_fetches
+        out["unresolved_windows"] = bool(self._has_unresolved_windows())
+        return out
 
     __call__ = redistribute
 

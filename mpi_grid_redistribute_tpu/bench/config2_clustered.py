@@ -212,6 +212,15 @@ def run(
     per_u, st_u = measure(uniform_rows, owner_u, assign_u)
     dropped_u = int(st_u.dropped_recv.sum())
 
+    # merged telemetry surface for the imbalanced steady state (built
+    # BEFORE st_c/st_u are freed below — the at-size run is HBM-tight)
+    from mpi_grid_redistribute_tpu.telemetry import report as report_lib
+
+    report_imb = report_lib.exchange_report(
+        st_c, 4 * (2 * 3 + 1), step_seconds=per_c,
+        domain="ici" if n_chips > 1 else "hbm", n_chips=n_chips,
+    )
+
     pps_imb = total / per_c
     pps_uni = total / per_u
     common.log(
@@ -251,6 +260,7 @@ def run(
         "placement_rounds": rounds,
         "n_total": total,
         "chips": n_chips,
+        "report_imbalanced": report_imb,
     }
     return res
 

@@ -74,6 +74,12 @@ def run(n_local: int = None, migration: float = 0.02) -> dict:
         s2=24,
     )
     total = int(fill * n_local) * 64
+    from mpi_grid_redistribute_tpu.telemetry import report as report_lib
+
+    report = report_lib.exchange_report(
+        _out[3], 4 * (2 * 3 + 1), step_seconds=per_step,
+        domain="ici" if n_chips > 1 else "hbm", n_chips=n_chips,
+    )
     res = {
         "metric": "config3_slab_pps_per_chip",
         "value": round(total / per_step / n_chips, 2),
@@ -82,6 +88,7 @@ def run(n_local: int = None, migration: float = 0.02) -> dict:
         "n_total": total,
         "chips": n_chips,
         "ms_per_step": round(per_step * 1e3, 2),
+        "report": report,
     }
     common.log(f"config3: {per_step*1e3:.2f} ms/step, {total} particles")
     return res

@@ -52,6 +52,14 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
         s2=min(72, max(16, steps)),
     )
     total = int(fill * n_local) * 8
+    from mpi_grid_redistribute_tpu.telemetry import report as report_lib
+
+    # the merged telemetry surface: stats summary + bytes/step + bw_util
+    # (row = pos 3 + vel 3 + alive, fused f32)
+    report = report_lib.exchange_report(
+        _out[3], 4 * (2 * 3 + 1), step_seconds=per_step,
+        domain="ici" if n_chips > 1 else "hbm", n_chips=n_chips,
+    )
     res = {
         "metric": "config4_drift_pps_per_chip",
         "value": round(total / per_step / n_chips, 2),
@@ -59,6 +67,7 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
         "n_total": total,
         "chips": n_chips,
         "ms_per_step": round(per_step * 1e3, 2),
+        "report": report,
     }
     common.log(f"config4: {per_step*1e3:.2f} ms/step")
     return res

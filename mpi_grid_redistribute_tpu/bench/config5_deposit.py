@@ -80,6 +80,12 @@ def run(n_local: int = None, mesh_cells: int = 128,
     mass_ok = bool(
         np.isclose(rho.sum(), total - dropped, rtol=1e-4)
     )
+    from mpi_grid_redistribute_tpu.telemetry import report as report_lib
+
+    report = report_lib.exchange_report(
+        stats, 4 * (2 * 3 + 1), step_seconds=per_step,
+        domain="ici" if n_chips > 1 else "hbm", n_chips=n_chips,
+    )
 
     res = {
         "metric": "config5_fused_deposit_pps_per_chip",
@@ -92,6 +98,7 @@ def run(n_local: int = None, mesh_cells: int = 128,
         "ms_per_step": round(per_step * 1e3, 2),
         "mass_conserved": mass_ok,
         "dropped_recv": dropped,
+        "report": report,
     }
     common.log(
         f"config5: {per_step*1e3:.2f} ms/step fused exchange+CIC {dshape} "

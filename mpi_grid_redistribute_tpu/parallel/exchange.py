@@ -28,6 +28,12 @@ from mpi_grid_redistribute_tpu.compat import shard_map
 
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning, pack
+# rd:bin / rd:pack / rd:exchange / rd:unpack labels on the engine phases:
+# a jax.named_scope lands in XLA op metadata, so Perfetto/XProf traces and
+# HLO dumps group the pipeline by phase instead of op soup (telemetry
+# tentpole; scan-differenced phase COSTS come from telemetry.phases.
+# attribute_phases — these scopes are for trace/HLO readability).
+from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
 class RedistributeStats(NamedTuple):
@@ -68,35 +74,42 @@ def shard_redistribute_fn(
         me = lax.axis_index(axes).astype(jnp.int32)
         iota = jnp.arange(n, dtype=jnp.int32)
         valid = iota < count[0]
-        dest = binning.rank_of_position(pos, domain, grid, edges=edges)
-        dest = jnp.where(valid, dest, R).astype(jnp.int32)
-        # Self-owned rows stay local (never hit the wire); the sentinel R
-        # routes both invalid and self rows out of the remote pack.
-        is_self = valid & (dest == me)
-        dest_remote = jnp.where(is_self, R, dest)
-        # One stable sort yields both the pack permutation and the
-        # per-destination counts (segment_sum histograms lower to a slow
-        # scatter-add on TPU — binning.sorted_dest_counts).
-        order, remote_counts, _ = binning.sorted_dest_counts(dest_remote, R)
+        with traced_span("rd:bin"):
+            dest = binning.rank_of_position(pos, domain, grid, edges=edges)
+            dest = jnp.where(valid, dest, R).astype(jnp.int32)
+            # Self-owned rows stay local (never hit the wire); the
+            # sentinel R routes both invalid and self rows out of the
+            # remote pack.
+            is_self = valid & (dest == me)
+            dest_remote = jnp.where(is_self, R, dest)
+            # One stable sort yields both the pack permutation and the
+            # per-destination counts (segment_sum histograms lower to a
+            # slow scatter-add on TPU — binning.sorted_dest_counts).
+            order, remote_counts, _ = binning.sorted_dest_counts(
+                dest_remote, R
+            )
         dropped_send = jnp.sum(jnp.maximum(remote_counts - capacity, 0))
         send_counts = jnp.minimum(remote_counts, capacity)
 
         arrays = (pos,) + tuple(fields)
-        packed = pack.pack_by_destination(
-            dest_remote, remote_counts, arrays, capacity, order=order
-        )
-        recv_counts = lax.all_to_all(
-            send_counts, axes, split_axis=0, concat_axis=0, tiled=True
-        )
-        recv = jax.tree.map(
-            lambda a: lax.all_to_all(
-                a, axes, split_axis=0, concat_axis=0, tiled=True
-            ),
-            packed,
-        )
-        out, new_count, dropped_recv = pack.compact_with_self(
-            recv, recv_counts, arrays, is_self, me, out_capacity
-        )
+        with traced_span("rd:pack"):
+            packed = pack.pack_by_destination(
+                dest_remote, remote_counts, arrays, capacity, order=order
+            )
+        with traced_span("rd:exchange"):
+            recv_counts = lax.all_to_all(
+                send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+            recv = jax.tree.map(
+                lambda a: lax.all_to_all(
+                    a, axes, split_axis=0, concat_axis=0, tiled=True
+                ),
+                packed,
+            )
+        with traced_span("rd:unpack"):
+            out, new_count, dropped_recv = pack.compact_with_self(
+                recv, recv_counts, arrays, is_self, me, out_capacity
+            )
         self_count = jnp.sum(is_self.astype(jnp.int32))
         self_onehot = (jnp.arange(R, dtype=jnp.int32) == me) * self_count
         stats = RedistributeStats(
@@ -144,19 +157,23 @@ def vrank_redistribute_fn(
         def pack_one(pos_v, count_v, me, *fields_v):
             iota = jnp.arange(n, dtype=jnp.int32)
             valid = iota < count_v
-            dest = binning.rank_of_position(pos_v, domain, grid, edges=edges)
-            dest = jnp.where(valid, dest, V).astype(jnp.int32)
-            is_self = valid & (dest == me)
-            dest_remote = jnp.where(is_self, V, dest)
-            order, remote_counts, _ = binning.sorted_dest_counts(
-                dest_remote, V
-            )
+            with traced_span("rd:bin"):
+                dest = binning.rank_of_position(
+                    pos_v, domain, grid, edges=edges
+                )
+                dest = jnp.where(valid, dest, V).astype(jnp.int32)
+                is_self = valid & (dest == me)
+                dest_remote = jnp.where(is_self, V, dest)
+                order, remote_counts, _ = binning.sorted_dest_counts(
+                    dest_remote, V
+                )
             dropped_send = jnp.sum(jnp.maximum(remote_counts - capacity, 0))
             send_counts = jnp.minimum(remote_counts, capacity)
-            packed = pack.pack_by_destination(
-                dest_remote, remote_counts, (pos_v,) + tuple(fields_v),
-                capacity, order=order,
-            )
+            with traced_span("rd:pack"):
+                packed = pack.pack_by_destination(
+                    dest_remote, remote_counts, (pos_v,) + tuple(fields_v),
+                    capacity, order=order,
+                )
             needed = jnp.max(remote_counts).astype(jnp.int32)
             return packed, send_counts, is_self, dropped_send, needed
 
@@ -164,7 +181,8 @@ def vrank_redistribute_fn(
             pack_one
         )(pos, count, me_ids, *fields)
         # the wire, as a transpose: [V_src, V_dst, C, ...] -> dst-major
-        recv = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), packed)
+        with traced_span("rd:exchange"):
+            recv = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), packed)
         recv_counts = send_counts.T  # [V_dst, V_src]
 
         def compact_one(recv_v, recv_counts_v, me, self_mask_v, pos_v,
@@ -174,9 +192,10 @@ def vrank_redistribute_fn(
                 self_mask_v, me, out_capacity,
             )
 
-        out, new_count, dropped_recv = jax.vmap(compact_one)(
-            recv, recv_counts, me_ids, is_self, pos, *fields
-        )
+        with traced_span("rd:unpack"):
+            out, new_count, dropped_recv = jax.vmap(compact_one)(
+                recv, recv_counts, me_ids, is_self, pos, *fields
+            )
         self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
         self_diag = jnp.diag(self_count)
         stats = RedistributeStats(
@@ -258,18 +277,22 @@ def vrank_redistribute_planar_fn(
         def pack_one(fi_v, pos_v, count_v, me):
             iota = jnp.arange(n, dtype=jnp.int32)
             valid = iota < count_v
-            dest = binning.rank_of_position_planar(pos_v, domain, grid, edges=edges)
-            dest = jnp.where(valid, dest, V).astype(jnp.int32)
-            is_self = valid & (dest == me)
-            dest_remote = jnp.where(is_self, V, dest)
-            order, remote_counts, bounds = binning.sorted_dest_counts(
-                dest_remote, V
-            )
+            with traced_span("rd:bin"):
+                dest = binning.rank_of_position_planar(
+                    pos_v, domain, grid, edges=edges
+                )
+                dest = jnp.where(valid, dest, V).astype(jnp.int32)
+                is_self = valid & (dest == me)
+                dest_remote = jnp.where(is_self, V, dest)
+                order, remote_counts, bounds = binning.sorted_dest_counts(
+                    dest_remote, V
+                )
             dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
             send_counts = jnp.minimum(remote_counts, C)
-            packed, _ = pack.pack_cols(
-                fi_v, order, bounds[:V], send_counts, V, C
-            )  # [K, V*C] int32
+            with traced_span("rd:pack"):
+                packed, _ = pack.pack_cols(
+                    fi_v, order, bounds[:V], send_counts, V, C
+                )  # [K, V*C] int32
             needed = jnp.max(remote_counts).astype(jnp.int32)
             return packed, send_counts, is_self, dropped_send, needed
 
@@ -278,11 +301,12 @@ def vrank_redistribute_planar_fn(
         )(fi, pos_f, count, me_ids)
         K = fused.shape[1]
         # the wire, as a transpose: [V_src, K, V_dst, C] -> dst-major pools
-        recv = (
-            packed.reshape(V, K, V, C)
-            .transpose(2, 1, 0, 3)
-            .reshape(V, K, V * C)
-        )
+        with traced_span("rd:exchange"):
+            recv = (
+                packed.reshape(V, K, V, C)
+                .transpose(2, 1, 0, 3)
+                .reshape(V, K, V * C)
+            )
         recv_counts = send_counts.T  # [V_dst, V_src]
 
         def compact_one(pool_v, rcnt_v, me, self_mask_v, fi_v):
@@ -294,9 +318,10 @@ def vrank_redistribute_planar_fn(
                 pool_v, rcnt_v, me, self_mask_v, fi_v, out_capacity
             )
 
-        out, new_count, dropped_recv = jax.vmap(compact_one)(
-            recv, recv_counts, me_ids, is_self, fi
-        )
+        with traced_span("rd:unpack"):
+            out, new_count, dropped_recv = jax.vmap(compact_one)(
+                recv, recv_counts, me_ids, is_self, fi
+            )
         if as_f32:
             out = lax.bitcast_convert_type(out, jnp.float32)
         self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
@@ -372,33 +397,40 @@ def shard_redistribute_planar_fn(
         me = lax.axis_index(axes).astype(jnp.int32)
         iota = jnp.arange(n, dtype=jnp.int32)
         valid = iota < count[0]
-        dest = binning.rank_of_position_planar(pos_f, domain, grid, edges=edges)
-        dest = jnp.where(valid, dest, R).astype(jnp.int32)
-        # Self-owned columns stay local (never hit the wire); sentinel R
-        # routes both invalid and self columns out of the remote pack.
-        is_self = valid & (dest == me)
-        dest_remote = jnp.where(is_self, R, dest)
-        order, remote_counts, bounds = binning.sorted_dest_counts(
-            dest_remote, R
-        )
+        with traced_span("rd:bin"):
+            dest = binning.rank_of_position_planar(
+                pos_f, domain, grid, edges=edges
+            )
+            dest = jnp.where(valid, dest, R).astype(jnp.int32)
+            # Self-owned columns stay local (never hit the wire); sentinel
+            # R routes both invalid and self columns out of the remote
+            # pack.
+            is_self = valid & (dest == me)
+            dest_remote = jnp.where(is_self, R, dest)
+            order, remote_counts, bounds = binning.sorted_dest_counts(
+                dest_remote, R
+            )
         dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
         send_counts = jnp.minimum(remote_counts, C)
-        packed, _ = pack.pack_cols(
-            fi, order, bounds[:R], send_counts, R, C
-        )  # [K, R*C] int32, dest-major slots
-        recv_counts = lax.all_to_all(
-            send_counts, axes, split_axis=0, concat_axis=0, tiled=True
-        )
-        # The wire: tiled all_to_all splits the lane axis into R chunks of
-        # C columns (chunk d -> rank d) and concatenates receives
-        # source-major — exactly the [K, R*C] dst-major pool the vrank
-        # twin builds with its transpose.
-        pool = lax.all_to_all(
-            packed, axes, split_axis=1, concat_axis=1, tiled=True
-        )
-        out, new_count, dropped_recv = pack.planar_compact_with_self(
-            pool, recv_counts, me, is_self, fi, out_capacity
-        )
+        with traced_span("rd:pack"):
+            packed, _ = pack.pack_cols(
+                fi, order, bounds[:R], send_counts, R, C
+            )  # [K, R*C] int32, dest-major slots
+        with traced_span("rd:exchange"):
+            recv_counts = lax.all_to_all(
+                send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+            # The wire: tiled all_to_all splits the lane axis into R
+            # chunks of C columns (chunk d -> rank d) and concatenates
+            # receives source-major — exactly the [K, R*C] dst-major pool
+            # the vrank twin builds with its transpose.
+            pool = lax.all_to_all(
+                packed, axes, split_axis=1, concat_axis=1, tiled=True
+            )
+        with traced_span("rd:unpack"):
+            out, new_count, dropped_recv = pack.planar_compact_with_self(
+                pool, recv_counts, me, is_self, fi, out_capacity
+            )
         if as_f32:
             out = lax.bitcast_convert_type(out, jnp.float32)
         self_count = jnp.sum(is_self.astype(jnp.int32))

@@ -93,6 +93,9 @@ from jax import lax
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
 from mpi_grid_redistribute_tpu.ops.pack import pack_cols as _pack_cols
+# mig:bin / mig:pack / mig:exchange / mig:unpack named scopes on the step
+# phases — XLA op metadata for Perfetto/XProf grouping (telemetry.phases)
+from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
 def _resolve_scatter_impl(scatter_impl) -> str:
@@ -554,36 +557,40 @@ def shard_migrate_fused_fn(
         K = fused.shape[0]
         me = lax.axis_index(axes).astype(jnp.int32)
         alive = fused[-1, :] > 0
-        # per-axis fused elementwise binning (no stacked [D, n]
-        # intermediates; see the vranks path for the measurement)
-        dest = jnp.zeros(fused.shape[1:], jnp.int32)
-        for d in range(D):
-            p = _pos_row(fused, d)
-            lo = jnp.asarray(domain.lo[d], p.dtype)
-            ext = jnp.asarray(domain.extent[d], p.dtype)
-            if domain.periodic[d]:
-                # reciprocal-multiply wrap: bit-equal for pow2 extents,
-                # 4x cheaper than the f32 division in jnp.remainder
-                p = lo + binning.remainder_fast(p - lo, domain.extent[d])
-                p = jnp.where(p >= lo + ext, lo, p)
-            inv_w = jnp.asarray(grid.shape[d], p.dtype) / ext
-            cell_d = jnp.clip(
-                jnp.floor((p - lo) * inv_w).astype(jnp.int32),
-                0,
-                grid.shape[d] - 1,
-            )
-            dest = dest + cell_d * jnp.int32(grid.strides[d])
-        leaving = alive & (dest != me)
-        # Sentinel R: holes and staying residents sort to the tail.
-        dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
+        with traced_span("mig:bin"):
+            # per-axis fused elementwise binning (no stacked [D, n]
+            # intermediates; see the vranks path for the measurement)
+            dest = jnp.zeros(fused.shape[1:], jnp.int32)
+            for d in range(D):
+                p = _pos_row(fused, d)
+                lo = jnp.asarray(domain.lo[d], p.dtype)
+                ext = jnp.asarray(domain.extent[d], p.dtype)
+                if domain.periodic[d]:
+                    # reciprocal-multiply wrap: bit-equal for pow2
+                    # extents, 4x cheaper than the f32 division in
+                    # jnp.remainder
+                    p = lo + binning.remainder_fast(p - lo, domain.extent[d])
+                    p = jnp.where(p >= lo + ext, lo, p)
+                inv_w = jnp.asarray(grid.shape[d], p.dtype) / ext
+                cell_d = jnp.clip(
+                    jnp.floor((p - lo) * inv_w).astype(jnp.int32),
+                    0,
+                    grid.shape[d] - 1,
+                )
+                dest = dest + cell_d * jnp.int32(grid.strides[d])
+            leaving = alive & (dest != me)
+            # Sentinel R: holes and staying residents sort to the tail.
+            dest_key = jnp.where(leaving, dest, R).astype(jnp.int32)
 
-        # two-level leaver selection; the [1, n] batch shape reuses the
-        # vrank engine's machinery (scalar-guard cond, see binning).
-        # order is prefix-only: valid through the leaver count, zero tail
-        # (see sorted_dest_counts_batched) — every read below is masked
-        # or sliced at granted counts.
-        o_b, c_b, b_b = binning.sorted_dest_counts_batched(dest_key[None], R)
-        order, full_counts, bounds = o_b[0], c_b[0], b_b[0]
+            # two-level leaver selection; the [1, n] batch shape reuses
+            # the vrank engine's machinery (scalar-guard cond, see
+            # binning). order is prefix-only: valid through the leaver
+            # count, zero tail (see sorted_dest_counts_batched) — every
+            # read below is masked or sliced at granted counts.
+            o_b, c_b, b_b = binning.sorted_dest_counts_batched(
+                dest_key[None], R
+            )
+            order, full_counts, bounds = o_b[0], c_b[0], b_b[0]
         desired = jnp.minimum(full_counts, C).astype(jnp.int32)
 
         # Receiver-side flow control (lossless receive): exchange DESIRED
@@ -630,19 +637,22 @@ def shard_migrate_fused_fn(
             recv_counts = recv_counts + F[:, me]
         backlog = jnp.sum(full_counts - send_counts).astype(jnp.int32)
 
-        send, gather_idx = _pack_cols(
-            fused, order, bounds, send_counts, R, C
-        )
-        recv = lax.all_to_all(
-            send.reshape(K, R, C).transpose(1, 0, 2), axes,
-            split_axis=0, concat_axis=0, tiled=True,
-        )  # [R, K, C]
-        recv = recv.transpose(1, 0, 2).reshape(K, R * C)
+        with traced_span("mig:pack"):
+            send, gather_idx = _pack_cols(
+                fused, order, bounds, send_counts, R, C
+            )
+        with traced_span("mig:exchange"):
+            recv = lax.all_to_all(
+                send.reshape(K, R, C).transpose(1, 0, 2), axes,
+                split_axis=0, concat_axis=0, tiled=True,
+            )  # [R, K, C]
+            recv = recv.transpose(1, 0, 2).reshape(K, R * C)
 
-        fused, free_stack, n_free, n_in, dropped_recv = _land_arrivals(
-            fused, free_stack, n_free, recv, recv_counts, send_counts,
-            gather_idx, C, impl,
-        )
+        with traced_span("mig:unpack"):
+            fused, free_stack, n_free, n_in, dropped_recv = _land_arrivals(
+                fused, free_stack, n_free, recv, recv_counts, send_counts,
+                gather_idx, C, impl,
+            )
         population = jnp.sum((fused[-1, :] > 0).astype(jnp.int32))
         stats = MigrateStats(
             sent=jnp.sum(send_counts).astype(jnp.int32)[None],
@@ -1038,9 +1048,10 @@ def shard_migrate_vranks_fn(
         # scalar guard cond-routes dense steps to the flat sort.
         # order is prefix-only (zero tail past the leavers; see
         # sorted_dest_counts_batched) — reads below slice/mask at counts.
-        order, counts, bounds = binning.sorted_dest_counts_batched(
-            dest_key, R_total
-        )  # [V, n], [V, R_total], [V, R_total + 1]
+        with traced_span("mig:bin"):
+            order, counts, bounds = binning.sorted_dest_counts_batched(
+                dest_key, R_total
+            )  # [V, n], [V, R_total], [V, R_total + 1]
         leavers = jnp.sum(counts, axis=1).astype(jnp.int32)  # [V]
 
         # ---- local allocation: [V_src, V_dst] on this device ----------
@@ -1243,15 +1254,16 @@ def shard_migrate_vranks_fn(
             )
             # [K, V_src, Dev, V_dst, C] -> [Dev, V_src, V_dst, K, C]
             send = send.transpose(2, 1, 3, 0, 4)
-            recv = lax.all_to_all(
-                send, axes, split_axis=0, concat_axis=0, tiled=True
-            )  # [Dev_src, V_src, V_dst, K, C]
-            # per-dst pools: [V_dst, K, Dev_src * V_src * C]; arrival
-            # counts (recv_counts_rem) were derived locally in the grant
-            # phase — no extra counts exchange needed
-            recv = recv.transpose(2, 3, 0, 1, 4).reshape(
-                V, K, Dev * V * C
-            )
+            with traced_span("mig:exchange"):
+                recv = lax.all_to_all(
+                    send, axes, split_axis=0, concat_axis=0, tiled=True
+                )  # [Dev_src, V_src, V_dst, K, C]
+                # per-dst pools: [V_dst, K, Dev_src * V_src * C]; arrival
+                # counts (recv_counts_rem) were derived locally in the
+                # grant phase — no extra counts exchange needed
+                recv = recv.transpose(2, 3, 0, 1, 4).reshape(
+                    V, K, Dev * V * C
+                )
 
         n_sent = sent_local + sent_remote
 
@@ -1309,13 +1321,14 @@ def shard_migrate_vranks_fn(
         # result to s * n + row; the vmapped `order[s, pos]` form this
         # replaces pays the ~33 ns/element batched-gather toll — the
         # round-4 knockout hid it inside the in-context landing phase).
-        arr_src, _ = _plan_rows_batched(
-            loc_starts.T, allowed.T, order, M,
-            seg_rows=jnp.arange(V, dtype=jnp.int32),
-        )  # [V_dst, M] global source columns
-        arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
-            K, V, M
-        )
+        with traced_span("mig:pack"):
+            arr_src, _ = _plan_rows_batched(
+                loc_starts.T, allowed.T, order, M,
+                seg_rows=jnp.arange(V, dtype=jnp.int32),
+            )  # [V_dst, M] global source columns
+            arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
+                K, V, M
+            )
 
         # ---- landing plan: one flat scatter for arrivals + holes ------
         k_idx = jnp.arange(P, dtype=jnp.int32)
@@ -1379,10 +1392,11 @@ def shard_migrate_vranks_fn(
         cols_w = jnp.where(
             (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0
         )
-        flat = _land_scatter(
-            flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
-            scatter_impl,
-        )
+        with traced_span("mig:unpack"):
+            flat = _land_scatter(
+                flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
+                scatter_impl,
+            )
 
         # ---- free-stack update (contiguous window blend) --------------
         n_push = jnp.maximum(n_sent - n_in_local, 0)

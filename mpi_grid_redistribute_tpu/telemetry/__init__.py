@@ -1,0 +1,47 @@
+"""Unified telemetry: the always-on observability layer (SURVEY.md §5.1/§5.5).
+
+Turns the scattered instruments that grew around the engines — the
+scan-differencing timers in :mod:`..utils.profiling`, the stats summaries
+in :mod:`..utils.stats`, the per-op knockout scripts — into one subsystem
+with four pieces:
+
+* :mod:`.recorder` — a bounded host-side ring buffer of structured events
+  (capacity growth, overflow window scheduling/resolution, halo cap
+  growth, per-step exchange counters) with JSONL export. Every
+  :class:`~..api.GridRedistribute` owns one as ``rd.telemetry``.
+* :mod:`.phases` — reusable phase attribution: ``attribute_phases()``
+  wraps the knockout/scan-differencing technique behind one API, and
+  ``span()``/``traced_span()`` label host regions (Perfetto
+  ``TraceAnnotation``) and traced regions (``jax.named_scope`` → XLA op
+  metadata) so profiles read as bin/pack/exchange/unpack, not op soup.
+* :mod:`.report` — the metrics surface: one merged dict (stats summary,
+  exchange bytes/step, achieved GB/s, ``bw_util`` against the HBM/ICI
+  roofs in :mod:`..utils.profiling`, growth/overflow event counts),
+  reachable as ``rd.report()`` and emitted by every bench driver.
+* :mod:`.regress` — the regression guard: min-of-k timing protocol with
+  spread reporting plus a checker comparing a bench capture against the
+  committed ``BENCH_r*.json`` history, failing loudly (exit code + report
+  line) on >10% regressions (``make bench-check``).
+"""
+
+from mpi_grid_redistribute_tpu.telemetry.recorder import (  # noqa: F401
+    Event,
+    StepRecorder,
+    record_migrate_steps,
+)
+from mpi_grid_redistribute_tpu.telemetry.phases import (  # noqa: F401
+    PhaseTiming,
+    attribute_phases,
+    format_phase_table,
+    span,
+    traced_span,
+)
+from mpi_grid_redistribute_tpu.telemetry.report import (  # noqa: F401
+    exchange_report,
+    row_bytes_of,
+)
+from mpi_grid_redistribute_tpu.telemetry.regress import (  # noqa: F401
+    check_capture,
+    extract_metrics,
+    min_of_k,
+)

@@ -1,0 +1,171 @@
+"""Step recorder: bounded host-side ring buffer of structured events.
+
+Production systems attribute their own incidents; this is the journal the
+rest of the repo writes to. Events are plain host-side dicts — recording
+one is a deque append and NEVER syncs the device (the same contract the
+deferred overflow checks in :mod:`..api` keep), so the recorder can stay
+on in steady-state loops. The ring is bounded (default 4096 events);
+all-time per-kind counts survive eviction, so ``counts()`` is exact even
+when the ring has wrapped.
+
+Event kinds emitted by the in-repo instruments:
+
+* ``redistribute`` / ``halo`` — one per public API call (call index,
+  capacities, rows).
+* ``capacity_grow`` / ``halo_grow`` — a measured overflow grew a
+  capacity (old/new values, the measured need that sized the rebuild).
+* ``overflow_window_scheduled`` / ``overflow_window_clean`` /
+  ``overflow_window_loss`` — the deferred-check lifecycle (SURVEY.md
+  §5.3: surfaced, never silent).
+* ``migrate_step`` — per-step send/recv/backlog counters from a
+  step-stacked ``MigrateStats`` (:func:`record_migrate_steps`).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import json
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+
+class Event(NamedTuple):
+    """One recorded event: monotone sequence number, host wall time
+    (``time.time()``), kind tag, and a flat JSON-serializable payload."""
+
+    seq: int
+    time: float
+    kind: str
+    data: dict
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seq": self.seq, "time": self.time, "kind": self.kind,
+             **self.data},
+            sort_keys=True,
+        )
+
+
+class StepRecorder:
+    """Bounded ring buffer of :class:`Event` with all-time kind counts.
+
+    ``capacity`` bounds retained events (oldest evicted first); the
+    per-kind counters in :meth:`counts` are all-time, so operators can
+    distinguish "no growth events ever" from "growth events scrolled
+    off". ``enabled=False`` turns :meth:`record` into a no-op counter
+    bump — the shape of the API stays, the memory goes away.
+    """
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True):
+        if int(capacity) < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._ring: collections.deque = collections.deque(
+            maxlen=int(capacity)
+        )
+        self._counts: Dict[str, int] = {}
+        self._seq = 0
+        self.enabled = bool(enabled)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def total_recorded(self) -> int:
+        return self._seq
+
+    @property
+    def evicted(self) -> int:
+        """Events recorded but no longer retained (ring wrapped)."""
+        return self._seq - len(self._ring)
+
+    def record(self, kind: str, **data) -> None:
+        """Append one event. Host-side only; values must already be host
+        scalars (int/float/str) — pass ``int(...)``/``float(...)`` of any
+        device value at a point where syncing is acceptable, or better,
+        record only host-derived control-flow facts (capacities, call
+        indices, window bounds), which is what the in-repo hooks do."""
+        self._counts[kind] = self._counts.get(kind, 0) + 1
+        self._seq += 1
+        if self.enabled:
+            self._ring.append(Event(self._seq, time.time(), kind, data))
+
+    def events(self, kind: Optional[str] = None) -> List[Event]:
+        """Retained events, oldest first; optionally filtered by kind."""
+        if kind is None:
+            return list(self._ring)
+        return [e for e in self._ring if e.kind == kind]
+
+    def last(self, kind: Optional[str] = None) -> Optional[Event]:
+        evs = self.events(kind)
+        return evs[-1] if evs else None
+
+    def counts(self) -> Dict[str, int]:
+        """All-time events per kind (survives ring eviction)."""
+        return dict(self._counts)
+
+    def clear(self) -> None:
+        """Drop retained events AND all-time counts (fresh journal)."""
+        self._ring.clear()
+        self._counts = {}
+        self._seq = 0
+
+    def to_jsonl(self, path_or_file) -> int:
+        """Write retained events as JSON Lines; returns events written.
+
+        Accepts a path or an open text file. The export is the retained
+        window only — pair with :meth:`counts` (exact all-time totals)
+        when the ring may have wrapped.
+        """
+        events = self.events()
+        if isinstance(path_or_file, (str, bytes)):
+            with open(path_or_file, "w") as f:
+                for e in events:
+                    f.write(e.to_json() + "\n")
+        else:
+            f = path_or_file
+            for e in events:
+                f.write(e.to_json() + "\n")
+        return len(events)
+
+    def dumps_jsonl(self) -> str:
+        buf = io.StringIO()
+        self.to_jsonl(buf)
+        return buf.getvalue()
+
+
+def record_migrate_steps(
+    recorder: StepRecorder, stats, max_steps: Optional[int] = None
+) -> int:
+    """Feed a step-stacked ``MigrateStats`` into ``recorder`` as one
+    ``migrate_step`` event per step (sent/received/backlog/dropped/
+    population totals). This is the bridge from the migrate loops — whose
+    stats come back as ``[S, R]`` device arrays — to the host journal;
+    calling it forces ONE host transfer of the (tiny) stats pytree, so
+    call it where the bench drivers already read stats, not inside a hot
+    loop. ``max_steps`` keeps only the trailing window. Returns the
+    number of events recorded."""
+    sent = np.asarray(stats.sent)
+    sent = sent.reshape(-1, sent.shape[-1])
+    recv = np.asarray(stats.received).reshape(sent.shape)
+    backlog = np.asarray(stats.backlog).reshape(sent.shape)
+    dropped = np.asarray(stats.dropped_recv).reshape(sent.shape)
+    pop = np.asarray(stats.population).reshape(sent.shape)
+    start = 0 if max_steps is None else max(0, sent.shape[0] - max_steps)
+    for s in range(start, sent.shape[0]):
+        recorder.record(
+            "migrate_step",
+            step=s,
+            sent=int(sent[s].sum()),
+            received=int(recv[s].sum()),
+            backlog=int(backlog[s].sum()),
+            dropped_recv=int(dropped[s].sum()),
+            population=int(pop[s].sum()),
+        )
+    return sent.shape[0] - start

@@ -1,0 +1,134 @@
+"""The metrics surface: one merged dict per exchange workload.
+
+The BASELINE metric is two-headed — "particles/sec/chip; ICI all_to_all
+BW utilization" — and before this module the utilization half lived as a
+hand-assembled expression in bench.py while the stats summaries lived in
+:mod:`..utils.stats`. :func:`exchange_report` merges the whole surface:
+stats summary, exchange bytes/step (total and moved/off-diagonal),
+achieved GB/s, ``bw_util`` against the domain roof
+(:func:`..utils.profiling.exchange_peak_bytes_per_sec`), and the
+recorder's growth/overflow event counts. ``GridRedistribute.report()``
+and every bench driver emit this dict, so the same numbers appear in
+tests, bench JSON and operator logs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.utils import profiling, stats as stats_lib
+
+
+def row_bytes_of(positions, *fields) -> int:
+    """Payload bytes one particle row carries across the exchange.
+
+    Sums position components plus every field's trailing elements, each
+    at its own itemsize — valid for both engine layouts, since planar
+    ``[K, n]`` and row-major ``[n, K]`` move the same logical row, only
+    tiled differently. Accepts anything with ``.shape``/``.dtype``
+    (arrays or ShapeDtypeStructs)."""
+    total = 0
+    for a in (positions, *fields):
+        per_row = int(np.prod(a.shape[1:])) if len(a.shape) > 1 else 1
+        total += per_row * np.dtype(a.dtype).itemsize
+    return total
+
+
+def _moved_bytes_per_step(stats, row_bytes: int) -> float:
+    """Mean OFF-DIAGONAL bytes/step: rows that changed ranks.
+
+    ``RedistributeStats.send_counts`` ``[..., R, R]`` includes the
+    diagonal (rows a rank keeps); those never cross the inter-chip wire,
+    so the ICI utilization divides moved bytes only. ``MigrateStats.sent``
+    already counts movers exclusively."""
+    if hasattr(stats, "sent"):
+        return profiling.exchange_bytes_per_step(stats, row_bytes)
+    send = np.asarray(stats.send_counts)
+    send = send.reshape(-1, send.shape[-2], send.shape[-1])
+    moved = send.sum(axis=(1, 2)) - np.einsum("sii->s", send)
+    return float(moved.mean()) * row_bytes
+
+
+def exchange_report(
+    stats,
+    row_bytes: int,
+    *,
+    step_seconds: Optional[float] = None,
+    domain: str = "hbm",
+    n_chips: int = 1,
+    recorder=None,
+) -> Dict[str, object]:
+    """Merged metrics dict for one exchange workload.
+
+    Args:
+      stats: a ``RedistributeStats`` or ``MigrateStats`` pytree (single
+        call or step-stacked) — the kind is detected and summarized with
+        the matching :mod:`..utils.stats` summary.
+      row_bytes: payload bytes per row (:func:`row_bytes_of`).
+      step_seconds: honest per-step seconds — pass a scan-differenced
+        measurement (:func:`..utils.profiling.scan_time_per_step`);
+        without it the byte totals are reported but the rate/utilization
+        fields are ``None`` (a wall-clock guess would overstate dispatch
+        overhead as wire time, so none is silently substituted).
+      domain: ``"hbm"`` (single-chip vrank exchange) or ``"ici"``
+        (multi-chip all_to_all) — selects the roof AND which byte count
+        utilization divides: HBM moves every gathered/scattered row,
+        the ICI wire only the moved (off-diagonal) ones.
+      n_chips: chips sharing the aggregate byte rate.
+      recorder: optional :class:`..telemetry.recorder.StepRecorder`; its
+        all-time per-kind counts land under ``"events"``.
+
+    The dict is JSON-serializable (plain floats/ints/strs/dicts).
+    """
+    is_migrate = hasattr(stats, "sent")
+    summary = (
+        stats_lib.summarize_migrate(stats)
+        if is_migrate
+        else stats_lib.summarize_redistribute(stats)
+    )
+    total_bps = profiling.exchange_bytes_per_step(stats, row_bytes)
+    moved_bps = _moved_bytes_per_step(stats, row_bytes)
+    wire_bytes = moved_bps if domain == "ici" else total_bps
+    out: Dict[str, object] = {
+        "kind": "migrate" if is_migrate else "redistribute",
+        "stats": summary,
+        "row_bytes": int(row_bytes),
+        "exchange_bytes_per_step": total_bps,
+        "moved_bytes_per_step": moved_bps,
+        "exchange_domain": domain,
+        "n_chips": int(n_chips),
+        "step_seconds": step_seconds,
+        "exchange_bytes_per_sec": None,
+        "exchange_gb_per_sec": None,
+        "bw_util": None,
+    }
+    if step_seconds is not None and step_seconds > 0:
+        bps = wire_bytes / step_seconds
+        out["exchange_bytes_per_sec"] = bps
+        out["exchange_gb_per_sec"] = bps / 1e9
+        out["bw_util"] = profiling.exchange_bw_util(bps, domain, n_chips)
+    if recorder is not None:
+        out["events"] = recorder.counts()
+        out["events_evicted"] = recorder.evicted
+    return out
+
+
+def format_report(report: Dict[str, object]) -> str:
+    """One human line from an :func:`exchange_report` dict."""
+    bw = report.get("bw_util")
+    gbs = report.get("exchange_gb_per_sec")
+    rate = (
+        "rate: pass step_seconds"
+        if gbs is None
+        else f"{gbs:.2f} GB/s ({bw*100:.2f}% of {report['exchange_domain']})"
+    )
+    ev = report.get("events") or {}
+    grows = ev.get("capacity_grow", 0) + ev.get("halo_grow", 0)
+    return (
+        f"{report['kind']}: {report['exchange_bytes_per_step']/1e6:.2f} "
+        f"MB/step ({report['moved_bytes_per_step']/1e6:.2f} moved), "
+        f"{rate}, grows={grows}"
+    )

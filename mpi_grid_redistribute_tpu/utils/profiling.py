@@ -50,6 +50,48 @@ def scan_time_per_step(
     measurement), and the long loop's output pytree lets callers inspect
     stats without paying another invocation.
     """
+    per_step, overhead, out, _ = _scan_time_impl(
+        make_loop, args, s1, s2, reps
+    )
+    return per_step, overhead, out
+
+
+def scan_time_per_step_samples(
+    make_loop: Callable[[int], Callable],
+    args,
+    s1: int = 8,
+    s2: int = 72,
+    reps: int = 4,
+):
+    """Min-of-k variant of :func:`scan_time_per_step` with spread.
+
+    Compiles the two loops ONCE, then takes ``reps`` independent long-loop
+    wall times; each yields its own per-step estimate against the best
+    short-loop time, so the k estimates measure run-to-run noise, not
+    compile noise (the protocol ``telemetry.regress`` documents: noise on
+    a quiet machine is one-sided — interference only ADDS time — so min
+    is the estimator and ``spread = (max-min)/min`` is the capture's own
+    noise floor).
+
+    Returns ``(detail, long_out)`` where ``detail`` is
+    ``{min, max, mean, spread, k, values}`` of per-step seconds.
+    """
+    per_step, _overhead, out, samples = _scan_time_impl(
+        make_loop, args, s1, s2, reps
+    )
+    lo, hi = min(samples), max(samples)
+    detail = {
+        "min": lo,
+        "max": hi,
+        "mean": sum(samples) / len(samples),
+        "spread": (hi - lo) / lo if lo > 0 else 0.0,
+        "k": len(samples),
+        "values": samples,
+    }
+    return detail, out
+
+
+def _scan_time_impl(make_loop, args, s1, s2, reps):
     if s2 <= s1:
         raise ValueError(f"need s2 > s1 for differencing, got {s1} >= {s2}")
     loops = {s: make_loop(s) for s in (s1, s2)}
@@ -57,7 +99,7 @@ def scan_time_per_step(
     def run(s: int):
         out = loops[s](*args)
         fetch_barrier(out)  # warm: compile + first run
-        best = float("inf")
+        times = []
         for _ in range(reps):
             # free the previous run's output BEFORE the next invocation:
             # at bench sizes the output pytree is GB-scale device state,
@@ -67,14 +109,17 @@ def scan_time_per_step(
             t0 = time.perf_counter()
             out = loops[s](*args)
             fetch_barrier(out)
-            best = min(best, time.perf_counter() - t0)
-        return best, out
+            times.append(time.perf_counter() - t0)
+        return times, out
 
-    t1, out1 = run(s1)
+    times1, out1 = run(s1)
     del out1  # same: drop the short loop's state before the long compile
-    t2, out2 = run(s2)
-    per_step = (t2 - t1) / (s2 - s1)
-    return per_step, t1 - per_step * s1, out2
+    times2, out2 = run(s2)
+    t1 = min(times1)
+    # one per-step estimate per long rep, all against the best short time
+    samples = [(t2 - t1) / (s2 - s1) for t2 in times2]
+    per_step = min(samples)
+    return per_step, t1 - per_step * s1, out2, samples
 
 
 @contextlib.contextmanager
@@ -137,13 +182,17 @@ def exchange_bw_util(
 def exchange_bytes_per_step(stats, row_bytes: int) -> float:
     """Mean bytes crossing the exchange per step, from a stats pytree.
 
-    Works for both ``RedistributeStats`` (send_counts [S?, R, R]) and
-    ``MigrateStats`` (sent [S, R]); multiply by achieved step rate for
-    wire bandwidth, compare against ICI line rate for utilization.
+    Works for both ``RedistributeStats`` (send_counts [R, R], optionally
+    step-stacked to [S, R, R]) and ``MigrateStats`` (sent [R] or [S, R]);
+    multiply by achieved step rate for wire bandwidth, compare against
+    ICI line rate for utilization.
     """
     if hasattr(stats, "sent"):
         sent = np.asarray(stats.sent)
+        # normalize to [S, R]: a single-call stats pytree has no step axis
+        sent = sent.reshape(-1, sent.shape[-1])
     else:
         sent = np.asarray(stats.send_counts)
+        sent = sent.reshape((-1,) + sent.shape[-2:])  # [S, R, R]
     per_step = sent.reshape(sent.shape[0], -1).sum(axis=-1)
     return float(per_step.mean()) * row_bytes

@@ -27,10 +27,14 @@ def summarize_redistribute(stats) -> Dict[str, float]:
     send2 = send.reshape(-1, send.shape[-2], send.shape[-1])
     recv2 = recv.reshape(-1, recv.shape[-2], recv.shape[-1])
     moved = send2.sum(axis=(1, 2)) - np.einsum("sii->s", send2)
+    total = float(send2.sum(axis=(1, 2)).mean())
     return {
         "steps": send2.shape[0],
-        "total_rows": float(send2.sum(axis=(1, 2)).mean()),
+        "total_rows": total,
         "moved_rows": float(moved.mean()),
+        # the redistribute twin of migrate's migration_fraction: what
+        # share of rows changed ranks (off-diagonal / total)
+        "moved_fraction": float(moved.mean()) / max(total, 1.0),
         "recv_imbalance": _imbalance(recv2.sum(axis=2).mean(axis=0)),
         "dropped_send": int(np.asarray(stats.dropped_send).sum()),
         "dropped_recv": int(np.asarray(stats.dropped_recv).sum()),
@@ -42,7 +46,8 @@ def summarize_redistribute(stats) -> Dict[str, float]:
 
 def summarize_migrate(stats) -> Dict[str, float]:
     """Summary dict from a ``MigrateStats`` (optionally step-stacked)."""
-    sent = np.asarray(stats.sent).reshape(-1, np.asarray(stats.sent).shape[-1])
+    sent = np.asarray(stats.sent)
+    sent = sent.reshape(-1, sent.shape[-1])
     pop = np.asarray(stats.population).reshape(sent.shape)
     return {
         "steps": sent.shape[0],

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Bench regression guard CLI (`make bench-check`).
+
+Thin wrapper over :mod:`mpi_grid_redistribute_tpu.telemetry.regress` —
+invoking the module file directly (instead of ``python -m pkg.module``)
+avoids runpy's found-in-sys.modules RuntimeWarning from the package
+re-export. Same flags: ``--current``, ``--history``, ``--threshold``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_grid_redistribute_tpu.telemetry.regress import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,7 @@ from jax import lax
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
 from mpi_grid_redistribute_tpu.parallel import migrate
-from mpi_grid_redistribute_tpu.utils import profiling
+from mpi_grid_redistribute_tpu.telemetry import phases as phases_lib
 
 GRID = tuple(
     int(x) for x in os.environ.get("KNOCKOUT_GRID", "2,2,2").split(",")
@@ -365,75 +365,70 @@ def main():
         f"shapes: V={V} n={n} M={M} (plan rows/vrank), "
         f"~{migrants} migrants/step expected", file=sys.stderr,
     )
-    print(
-        "| phase (cumulative) | ms | delta | logical MB | roofline ms "
-        "| x-roofline |", file=sys.stderr,
-    )
-    print("|---|---|---|---|---|---|", file=sys.stderr)
     phases = [
         int(x)
         for x in os.environ.get(
             "KNOCKOUT_PHASES", "1,2,3,4,5,6,7,8"
         ).split(",")
     ]
-    prev = None
-    for phase in phases:
+
+    def loop_builder(phase, S):
         step = truncated_step(domain, vgrid, C, M, n, phase)
 
-        def make_loop(S, step=step):
-            @jax.jit
-            def loop(fused, free_stack, n_free):
-                st = migrate.MigrateState(fused, free_stack, n_free)
+        @jax.jit
+        def loop(fused, free_stack, n_free):
+            st = migrate.MigrateState(fused, free_stack, n_free)
 
-                def body(st, _):
-                    # drift so dest_key changes each step (int32 carry,
-                    # f32 views — matches nbody.make_migrate_loop)
-                    f = st.fused
-                    pf = lax.bitcast_convert_type(f[:3, :], jnp.float32)
-                    vf = lax.bitcast_convert_type(f[3:6, :], jnp.float32)
-                    p = pf + vf * jnp.float32(1e-4)
-                    p = binning.wrap_periodic_planar(p, domain)
-                    if os.environ.get("KNOCKOUT_DRIFT") == "dus":
-                        f = lax.dynamic_update_slice(
-                            f, lax.bitcast_convert_type(p, jnp.int32),
-                            (0, 0),
-                        )
-                    else:
-                        f = jnp.concatenate(
-                            [
-                                lax.bitcast_convert_type(p, jnp.int32),
-                                f[3:, :],
-                            ],
-                            axis=0,
-                        )
-                    st2 = step(st._replace(fused=f))
-                    return st2, ()
+            def body(st, _):
+                # drift so dest_key changes each step (int32 carry,
+                # f32 views — matches nbody.make_migrate_loop)
+                f = st.fused
+                pf = lax.bitcast_convert_type(f[:3, :], jnp.float32)
+                vf = lax.bitcast_convert_type(f[3:6, :], jnp.float32)
+                p = pf + vf * jnp.float32(1e-4)
+                p = binning.wrap_periodic_planar(p, domain)
+                if os.environ.get("KNOCKOUT_DRIFT") == "dus":
+                    f = lax.dynamic_update_slice(
+                        f, lax.bitcast_convert_type(p, jnp.int32),
+                        (0, 0),
+                    )
+                else:
+                    f = jnp.concatenate(
+                        [
+                            lax.bitcast_convert_type(p, jnp.int32),
+                            f[3:, :],
+                        ],
+                        axis=0,
+                    )
+                st2 = step(st._replace(fused=f))
+                return st2, ()
 
-                st, _ = lax.scan(body, st, None, length=S)
-                return st.fused
+            st, _ = lax.scan(body, st, None, length=S)
+            return st.fused
 
-            return loop
+        return loop
 
-        per, _, _ = profiling.scan_time_per_step(
-            make_loop, tuple(state), s1=4, s2=16
-        )
-        mb = pb[phase] / 1e6
-        roof = pb[phase] / HBM_PEAK * 1e3
-        if prev is None:
-            print(
-                f"| {phase} | {per*1e3:7.2f} | (first) | {mb:8.1f} "
-                f"| {roof:6.2f} | — |",
-                file=sys.stderr, flush=True,
-            )
-        else:
-            delta = (per - prev) * 1e3
-            ratio = delta / roof if roof > 0 else float("inf")
-            print(
-                f"| {phase} | {per*1e3:7.2f} | {delta:+7.2f} | {mb:8.1f} "
-                f"| {roof:6.2f} | {ratio:6.1f} |",
-                file=sys.stderr, flush=True,
-            )
-        prev = per
+    # the attribution harness (telemetry.phases) owns the protocol:
+    # cumulative truncations, scan-differenced, streamed as table rows
+    for line in phases_lib.format_phase_table([]).splitlines():
+        print(line, file=sys.stderr, flush=True)
+    rows = []
+
+    def stream(row):
+        rows.append(row)
+        table = phases_lib.format_phase_table(rows)
+        print(table.splitlines()[-1], file=sys.stderr, flush=True)
+
+    phases_lib.attribute_phases(
+        loop_builder,
+        tuple(state),
+        phases,
+        s1=4,
+        s2=16,
+        phase_bytes=pb,
+        peak_bytes_per_sec=HBM_PEAK,
+        progress=stream,
+    )
 
 
 if __name__ == "__main__":

@@ -334,12 +334,12 @@ def main() -> None:
     )
     gedges = GridEdges.balanced_for(domain, grid, epos)
     kw = dict(capacity_factor=16.0, out_capacity=4 * 4096, edges=gedges)
-    res = GridRedistribute(domain, grid, mesh=mesh, **kw).redistribute(
-        epos
-    )
-    res_np = GridRedistribute(
-        domain, grid, backend="numpy", **kw
-    ).redistribute(epos)
+    # context-manager form: resolve deferred overflow windows at exit
+    # instead of warning from __del__ on these transient instances
+    with GridRedistribute(domain, grid, mesh=mesh, **kw) as rd_e:
+        res = rd_e.redistribute(epos)
+    with GridRedistribute(domain, grid, backend="numpy", **kw) as rd_np_e:
+        res_np = rd_np_e.redistribute(epos)
     assert (
         np.asarray(res.positions).tobytes()
         == np.asarray(res_np.positions).tobytes()

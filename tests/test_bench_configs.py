@@ -10,11 +10,37 @@ os.environ.setdefault("BENCH_SCALE", "0.01")
 
 
 def test_config1_oracle():
+    import gc
+    import warnings
+
     from mpi_grid_redistribute_tpu.bench import config1_oracle
 
-    out = config1_oracle.run(n_total=1 << 12, reps=1)
+    # RuntimeWarnings as errors: the driver must resolve its deferred
+    # overflow windows itself (flush/with), not warn from __del__
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        out = config1_oracle.run(n_total=1 << 12, reps=1)
+        gc.collect()  # trigger any leftover GridRedistribute.__del__ now
     assert out["bit_equal_vs_oracle"] is True
     assert out["value"] > 0
+    # the merged telemetry surface rides the bench JSON
+    rep = out["api_report"]
+    assert rep["kind"] == "redistribute"
+    assert rep["bw_util"] is not None and rep["bw_util"] > 0
+    assert rep["unresolved_windows"] is False
+
+
+def test_config7_stress():
+    from mpi_grid_redistribute_tpu.bench import config7_stress
+
+    out = config7_stress.run(n_total=1 << 12, reps=1)
+    # full-reshuffle regime: destinations are uniform, so ~(R-1)/R of
+    # rows change owner every step — far above any drift config
+    assert out["migration_fraction"] > 0.5
+    assert out["bw_util"] > 0
+    assert out["exchange_bytes_per_step"] > 0
+    assert out["timing_spread"] >= 0
+    assert out["exchange_domain"] == "hbm"
 
 
 def test_config2_clustered():
