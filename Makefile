@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test lint lint-json baseline bench-check
+.PHONY: test lint lint-json baseline bench-check observe
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -10,6 +10,18 @@ test:
 # >10% worse on any = exit 1. See mpi_grid_redistribute_tpu/telemetry/regress.py.
 bench-check:
 	$(PY) scripts/bench_check.py
+
+# grid observatory smoke: drift demo with the health monitor on, both
+# legs on 8 virtual CPU devices. Balanced leg must stay OK (unexpected
+# ALERT = exit 1) and writes a Perfetto trace; biased leg must ALERT
+# (no alert = exit 2). See telemetry/SCHEMA.md.
+observe:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
+		--trace observe_trace.json
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
+		--bias --expect-alert
 
 # gridlint: AST-based SPMD/JIT invariant checker (G001-G005).
 # Exit 0 = clean or fully baselined; 1 = new findings or stale baseline

@@ -39,6 +39,16 @@ def main() -> None:
                     help="drift steps (default 20)")
     ap.add_argument("--plot", action="store_true",
                     help="write drift_demo.png (needs matplotlib)")
+    ap.add_argument("--bias", action="store_true",
+                    help="convergent velocity field (particles pile into "
+                         "one shard) — demonstrates the health monitor "
+                         "firing a backlog-growth alert")
+    ap.add_argument("--trace", type=str, default=None,
+                    help="write a Perfetto/Chrome-trace JSON of the "
+                         "telemetry journal here")
+    ap.add_argument("--expect-alert", action="store_true",
+                    help="exit non-zero unless the monitor ALERTs (pair "
+                         "with --bias; `make observe` uses both modes)")
     args = ap.parse_args()
 
     import jax
@@ -107,6 +117,19 @@ def main() -> None:
         domain=domain, grid=dev_grid, dt=0.05, capacity=cap,
         n_local=out_cap,
     )
+    if args.bias:
+        # convergent flight plan: every particle flies straight at one
+        # shard's center, timed to be ~2/3 of the way there when the run
+        # ends — the sink shard's landing slots exhaust during the final
+        # steps, its grants dry up, and the senders' backlog is still
+        # climbing at the end (the failure mode the health monitor's
+        # backlog_growth rule pages on; timed-arrival keeps the stall
+        # from saturating into a flat backlog before the window closes)
+        sink = np.asarray([0.25, 0.25, 0.25], np.float32)
+        vel = (sink[None, :] - pos) / (args.steps * 0.05) * 0.65
+        res = rd.redistribute(pos, vel, ids)
+        rd.flush_overflow_checks()
+        count = np.asarray(res.count)
     loop = nbody.make_migrate_loop(cfg, mesh, args.steps, vgrid=vgrid)
     # drift from the redistributed (owner-placed) state; valid rows per
     # shard become the alive mask, the rest are free landing slots
@@ -130,6 +153,34 @@ def main() -> None:
           + f"; migration {msum['migration_fraction']:.2%}/step, "
           f"population imbalance {msum['population_imbalance']:.3f}, "
           f"no particles lost")
+
+    # --- 2b. grid observatory: flow + health + trace (telemetry/) -------
+    from mpi_grid_redistribute_tpu import telemetry
+
+    rec = telemetry.StepRecorder()
+    telemetry.record_migrate_steps(rec, st, rank_totals=True)
+    acc = telemetry.FlowAccumulator()
+    acc.update(st)
+    telemetry.record_flow_snapshot(rec, acc)
+    monitor = telemetry.HealthMonitor(
+        rec,
+        on_alert=lambda f: print(f"  !! {f.severity} {f.rule}: {f.reason}"),
+    )
+    verdict = monitor.evaluate()
+    hot = acc.top_pairs(k=3)
+    print(f"\nobservatory: health={verdict['status']}; "
+          f"imbalance {acc.imbalance:.2f}x; hot links "
+          + ", ".join(f"{s}->{d}:{n}" for s, d, n in hot))
+    if args.trace:
+        n_ev = telemetry.write_trace(args.trace, rec)
+        print(f"wrote {args.trace} ({n_ev} trace events)")
+    if args.expect_alert and verdict["status"] != "ALERT":
+        print("expected an ALERT but the monitor stayed "
+              f"{verdict['status']}")
+        sys.exit(2)
+    if not args.expect_alert and verdict["status"] == "ALERT":
+        print("unexpected ALERT on a balanced workload")
+        sys.exit(1)
 
     # --- 3. optional density plot ---------------------------------------
     if args.plot:

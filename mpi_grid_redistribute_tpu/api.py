@@ -33,8 +33,11 @@ from mpi_grid_redistribute_tpu import oracle
 from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
 from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
 from mpi_grid_redistribute_tpu.parallel.halo import HaloResult
+from mpi_grid_redistribute_tpu.telemetry import flow as flow_lib
+from mpi_grid_redistribute_tpu.telemetry import health as health_lib
 from mpi_grid_redistribute_tpu.telemetry import recorder as telemetry_lib
 from mpi_grid_redistribute_tpu.telemetry import report as report_lib
+from mpi_grid_redistribute_tpu.telemetry import traceview as traceview_lib
 
 
 class RedistributeResult(NamedTuple):
@@ -408,6 +411,13 @@ class GridRedistribute:
         self.telemetry = telemetry_lib.StepRecorder()
         self._last_stats = None
         self._last_row_bytes = None
+        # Grid observatory (telemetry/flow.py, health.py): the per-link
+        # flow gauge and the always-on rule monitor share this instance's
+        # journal. Both are host-side only — folding stats into the
+        # accumulator happens inside flow()/health() (a tiny explicit
+        # sync at the caller's chosen boundary), never per call.
+        self.flow_acc = flow_lib.FlowAccumulator()
+        self.monitor = health_lib.HealthMonitor(self.telemetry)
         self.capacity = capacity
         self.capacity_factor = float(capacity_factor)
         self.out_capacity = out_capacity
@@ -1097,6 +1107,57 @@ class GridRedistribute:
         out["blocking_fetches"] = self._blocking_fetches
         out["unresolved_windows"] = bool(self._has_unresolved_windows())
         return out
+
+    def flow(self, k: int = 5, update: bool = True) -> dict:
+        """Per-link flow view of the LAST redistribute call
+        (:mod:`~.telemetry.flow`): the ``[R, R]`` matrix (entry ``[i, j]``
+        = rows rank ``i`` sent rank ``j``; row sums equal the per-rank
+        send totals, column sums the receive totals), the cumulative
+        matrix and population-imbalance gauge from this instance's
+        :class:`~.telemetry.flow.FlowAccumulator`, and the ``k`` hottest
+        off-diagonal links.
+
+        ``update=True`` (default) folds the last stats into the gauge
+        and journals a compact ``flow_snapshot`` event — call it at the
+        same boundaries as :meth:`report` (this reads the stats pytree
+        to the host; tiny, but a sync).
+        """
+        if self._last_stats is None:
+            raise RuntimeError("flow() needs at least one redistribute() call")
+        matrix = flow_lib.flow_matrix_of(self._last_stats)[-1]
+        if update:
+            self.flow_acc.update(self._last_stats)
+            flow_lib.record_flow_snapshot(self.telemetry, self.flow_acc, k=k)
+        return {
+            "matrix": matrix,
+            "cumulative": self.flow_acc.cumulative,
+            "imbalance": self.flow_acc.imbalance,
+            "hot_links": self.flow_acc.top_pairs(k=k),
+            "snapshot": self.flow_acc.snapshot(k=k),
+        }
+
+    def health(self) -> dict:
+        """Evaluate the always-on health rules
+        (:class:`~.telemetry.health.HealthMonitor`) against this
+        instance's journal: returns ``{"status": "OK"|"WARN"|"ALERT",
+        "findings": [{rule, severity, reason}, ...]}``. New findings are
+        journaled as ``alert`` events and fire any callbacks registered
+        via ``rd.monitor.add_callback``. Host-side only — never syncs
+        the device."""
+        return self.monitor.evaluate()
+
+    def to_perfetto(self, path: Optional[str] = None, **kwargs):
+        """Export this instance's journal as Chrome-trace/Perfetto JSON
+        (:mod:`~.telemetry.traceview`). With ``path`` the JSON is
+        written there (returns the event count); without it the trace
+        dict is returned. Extra kwargs (``phase_timings``,
+        ``step_seconds``) pass through to
+        :func:`~.telemetry.traceview.to_chrome_trace`."""
+        if path is not None:
+            return traceview_lib.write_trace(
+                path, self.telemetry, **kwargs
+            )
+        return traceview_lib.to_chrome_trace(self.telemetry, **kwargs)
 
     __call__ = redistribute
 

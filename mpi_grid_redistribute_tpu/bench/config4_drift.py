@@ -16,12 +16,19 @@ from mpi_grid_redistribute_tpu.bench import common
 from mpi_grid_redistribute_tpu.utils import profiling
 
 
-def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
+def run(
+    n_local: int = None,
+    migration: float = 0.02,
+    steps: int = 100,
+    bias: bool = None,
+) -> dict:
     import jax
     import jax.numpy as jnp
 
     scale = float(os.environ.get("BENCH_SCALE", 1.0))
     n_local = n_local or max(1 << 12, int(scale * (1 << 20)))
+    if bias is None:
+        bias = os.environ.get("BENCH_DRIFT_BIAS") == "1"
     grid_shape = (2, 2, 2)
     dev_grid, vgrid, mesh, n_chips = common.pick_layout(grid_shape)
     domain = Domain(0.0, 1.0, periodic=True)
@@ -31,9 +38,19 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
         grid_shape, n_local, fill, migration
     )
     pos, _, alive = common.uniform_state(grid_shape, n_local, fill, rng)
-    vel = (
-        v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
-    ).astype(np.float32)
+    s2 = min(72, max(16, steps))
+    if bias:
+        # BENCH_DRIFT_BIAS=1: convergent flight plan into one shard
+        # (same construction as examples/drift_demo.py --bias) — the
+        # workload unbalances, the sink's grants dry up, and the health
+        # monitor below must end the run in ALERT. NOT the guarded
+        # steady-state metric; captures for bench_check use bias off.
+        sink = np.asarray([0.25, 0.25, 0.25], np.float32)
+        vel = ((sink[None, :] - pos) / s2 * 0.65).astype(np.float32)
+    else:
+        vel = (
+            v_scale * (rng.random(pos.shape, dtype=np.float32) * 2.0 - 1.0)
+        ).astype(np.float32)
     cfg = nbody.DriftConfig(
         domain=domain, grid=dev_grid, dt=1.0, capacity=cap,
         n_local=n_local, local_budget=budget,
@@ -60,6 +77,20 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
         _out[3], 4 * (2 * 3 + 1), step_seconds=per_step,
         domain="ici" if n_chips > 1 else "hbm", n_chips=n_chips,
     )
+    # grid observatory: journal the stats we already read, evaluate the
+    # health rules, and ship the verdict alongside the metric — on the
+    # default balanced workload this must stay OK; under BENCH_DRIFT_BIAS
+    # the backlog-growth rule must page
+    from mpi_grid_redistribute_tpu import telemetry
+
+    rec = telemetry.StepRecorder()
+    telemetry.record_migrate_steps(rec, _out[3], rank_totals=True)
+    acc = telemetry.FlowAccumulator()
+    acc.update(_out[3])
+    telemetry.record_flow_snapshot(rec, acc)
+    monitor = telemetry.HealthMonitor(rec)
+    monitor.note_step_time(per_step)
+    verdict = monitor.evaluate()
     res = {
         "metric": "config4_drift_pps_per_chip",
         "value": round(total / per_step / n_chips, 2),
@@ -68,8 +99,15 @@ def run(n_local: int = None, migration: float = 0.02, steps: int = 100) -> dict:
         "chips": n_chips,
         "ms_per_step": round(per_step * 1e3, 2),
         "report": report,
+        "health": verdict,
+        "flow": acc.snapshot(k=5),
     }
-    common.log(f"config4: {per_step*1e3:.2f} ms/step")
+    if bias:
+        res["metric"] = "config4_drift_bias_pps_per_chip"
+        res["bias"] = True
+    common.log(
+        f"config4: {per_step*1e3:.2f} ms/step, health={verdict['status']}"
+    )
     return res
 
 

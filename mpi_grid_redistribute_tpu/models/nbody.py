@@ -198,7 +198,12 @@ def make_migrate_step(cfg: DriftConfig, mesh: Mesh):
         rho = dep_fn(pos, jnp.ones(pos.shape[:1], pos.dtype), alive)
         return pos, vel, alive, stats, rho
 
-    stats_spec = migrate.MigrateStats(*([spec] * len(migrate.MigrateStats._fields)))
+    # scalar-per-shard leaves stack on the shard axis -> global [R]; the
+    # flow leaf is a [1, R] row per shard -> global [R, R] (rows sharded)
+    stats_spec = migrate.MigrateStats(
+        *([spec] * (len(migrate.MigrateStats._fields) - 1)),
+        flow=P(axes, None),
+    )
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:
         out_specs = out_specs + (deposit_lib.deposit_out_spec(cfg.domain, cfg.grid),)
@@ -490,9 +495,12 @@ def make_migrate_loop(
         rho = carry[1] if deposit_each_step else _deposit(state.fused)
         return pos_f, vel_f, alive_f, stats, rho
 
-    # stats leaves are [S, 1] per shard (scan-stacked): shard axis 1.
+    # stats leaves are [S, V] per shard (scan-stacked): shard axis 1. The
+    # flow leaf is [S, V, R_total] per shard — vrank rows stack on axis 1
+    # into the global [S, R_total, R_total] step-stacked flow matrix.
     stats_spec = migrate.MigrateStats(
-        *([P(None, axes)] * len(migrate.MigrateStats._fields))
+        *([P(None, axes)] * (len(migrate.MigrateStats._fields) - 1)),
+        flow=P(None, axes, None),
     )
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:

@@ -267,11 +267,17 @@ def _sorted_per_segment(
     )
     frac = jnp.clip(rel_s - i0_s.astype(rel_s.dtype), 0.0, 1.0)
 
-    # corner-weight channels [N, 2^ndim], sorted order
+    # corner-weight channels [N, 2^ndim], sorted order. The product is an
+    # EXPLICIT left fold ((f0 * f1) * f2) rather than jnp.prod: XLA picks
+    # the reduce association per backend (CPU emits (f0 * f2) * f1 —
+    # measured, 1-2 ulp off), and the planar core pins the left fold, so
+    # pinning it here too keeps the two engines bit-identical everywhere.
     cols = []
     for corner in itertools.product((0, 1), repeat=ndim):
-        off = jnp.asarray(corner, jnp.int32)
-        w = jnp.prod(jnp.where(off == 1, frac, 1.0 - frac), axis=1)
+        w = None
+        for d in range(ndim):
+            t = frac[:, d] if corner[d] == 1 else 1.0 - frac[:, d]
+            w = t if w is None else w * t
         cols.append(mass_s * w)
     w8 = jnp.stack(cols, axis=1)
 
@@ -406,9 +412,9 @@ def _sorted_per_segment_planar(
     def per_group(corner_list):
         # corner-weight channel rows [g, N], sorted order. The product
         # association matches the row-major core exactly —
-        # mass * ((f0 * f1) * f2), i.e. jnp.prod's reduction order then
-        # the mass multiply — so the channel values are bit-identical (a
-        # different association rounds 1-2 ulp differently).
+        # mass * ((f0 * f1) * f2), the explicit left fold both engines
+        # pin — so the channel values are bit-identical (a different
+        # association rounds 1-2 ulp differently).
         rows = []
         for corner in corner_list:
             w = None

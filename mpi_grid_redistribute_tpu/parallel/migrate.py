@@ -201,13 +201,23 @@ class MigrateStats(NamedTuple):
     or by receiver grants (they stay resident and retry — never lost);
     ``dropped_recv`` remains as a surfaced safety counter for arrivals a
     receiver could not land, structurally zero now that sends are
-    receiver-granted."""
+    receiver-granted.
+
+    ``flow`` is the per-pair FLOW MATRIX (telemetry/flow.py): global
+    ``[R, R]`` int32, entry ``[i, j]`` = rows rank ``i`` sent to rank
+    ``j`` this step. It is the granted send-count table both engines
+    already compute for the pack phase, stacked into the stats pytree —
+    zero extra device work, zero host syncs. Row sums equal ``sent``
+    and column sums equal ``received`` exactly (sends are
+    receiver-granted, so the two sides agree by construction). Defaults
+    to ``None`` (an empty pytree leaf) for hand-built fixtures."""
 
     sent: jax.Array
     received: jax.Array
     population: jax.Array
     backlog: jax.Array
     dropped_recv: jax.Array  # structurally 0 since receiver-granted sends
+    flow: jax.Array = None  # [R, R] granted sends; None in old fixtures
 
 
 class MigrateState(NamedTuple):
@@ -660,6 +670,9 @@ def shard_migrate_fused_fn(
             population=population[None],
             backlog=backlog[None],
             dropped_recv=dropped_recv[None],
+            # granted sends, already computed for the pack phase: my row
+            # of the global [R, R] flow matrix (shard axis 0 stacks rows)
+            flow=send_counts[None],
         )
         return MigrateState(fused, free_stack, n_free), stats
 
@@ -1451,12 +1464,23 @@ def shard_migrate_vranks_fn(
         population = jnp.sum(
             (flat[-1, :].reshape(V, n) > 0).astype(jnp.int32), axis=1
         )
+        # my V rows of the global [R_total, R_total] flow matrix: remote
+        # granted sends with the local block overlaid (both tables are
+        # already live for the pack phase — pure stacking, no collective,
+        # no host sync). With Dev == 1 the local table IS the full matrix.
+        if Dev > 1:
+            flow_rows = lax.dynamic_update_slice(
+                rem_sent_full, allowed, (jnp.int32(0), loc0)
+            )  # [V, R_total]
+        else:
+            flow_rows = allowed
         stats = MigrateStats(
             sent=n_sent,
             received=received,
             population=population,
             backlog=backlog,
             dropped_recv=dropped_recv,
+            flow=flow_rows,
         )
         return MigrateState(flat, free_stack, n_free), stats
 

@@ -22,6 +22,23 @@ with four pieces:
   spread reporting plus a checker comparing a bench capture against the
   committed ``BENCH_r*.json`` history, failing loudly (exit code + report
   line) on >10% regressions (``make bench-check``).
+
+The grid observatory (PR 3) adds three layers on that substrate:
+
+* :mod:`.flow` — per-link flow attribution: the in-graph ``[R, R]``
+  flow matrix both engines stack into their stats pytrees,
+  :class:`~.flow.FlowAccumulator` host gauges (EMA + cumulative +
+  imbalance + hot pairs), ``flow_snapshot`` journal events, per-link
+  ``bw_util`` in :func:`~.report.exchange_report`.
+* :mod:`.health` — an always-on :class:`~.health.HealthMonitor`
+  evaluating declarative rules (backlog growth, dropped rows, grow
+  frequency, imbalance, step-time spikes) over the journal; findings
+  fire callbacks and land as ``alert`` events in the same ring.
+* :mod:`.traceview` — Perfetto/Chrome-trace JSON export of the journal,
+  phase attributions and migrate counter tracks
+  (``scripts/trace_export.py``; ``rd.to_perfetto()``).
+
+Event schema: ``telemetry/SCHEMA.md``.
 """
 
 from mpi_grid_redistribute_tpu.telemetry.recorder import (  # noqa: F401
@@ -44,4 +61,20 @@ from mpi_grid_redistribute_tpu.telemetry.regress import (  # noqa: F401
     check_capture,
     extract_metrics,
     min_of_k,
+)
+from mpi_grid_redistribute_tpu.telemetry.flow import (  # noqa: F401
+    FlowAccumulator,
+    flow_matrix_of,
+    link_report,
+    record_flow_snapshot,
+)
+from mpi_grid_redistribute_tpu.telemetry.health import (  # noqa: F401
+    Finding,
+    HealthMonitor,
+    HealthRule,
+    default_rules,
+)
+from mpi_grid_redistribute_tpu.telemetry.traceview import (  # noqa: F401
+    to_chrome_trace,
+    write_trace,
 )

@@ -1,0 +1,236 @@
+"""Always-on health monitor: declarative rules over the telemetry journal.
+
+Production systems page on *signals*, not on someone re-deriving a stall
+from raw counters. :class:`HealthMonitor` closes the loop between the
+journal (:class:`~.recorder.StepRecorder` events, including
+``flow_snapshot`` gauges from :mod:`.flow`) and the operator: a small
+set of declarative rules is evaluated on demand (``rd.health()``, bench
+boundaries, ``make observe``); each finding fires the registered
+callbacks AND records an ``alert`` event into the same ring, so alerts
+appear in the JSONL export and the Perfetto timeline next to the events
+that caused them.
+
+Evaluation is host-side dict scans only — the monitor never touches the
+device, so it keeps the recorder's steady-state contract (overhead gated
+at <= 2% of the config1 CPU step time, ``tests/test_flow.py``).
+
+The stock rules (:func:`default_rules`):
+
+* ``backlog_growth`` — total backlog strictly monotone increasing over
+  the last ``window`` ``migrate_step`` events (the drift-workload
+  failure mode: one shard fills and sends stop draining). ALERT.
+* ``dropped_rows`` — any ``migrate_step`` event with ``dropped_recv >
+  0``, or any ``overflow_window_loss`` ever (all-time counts, so a loss
+  that scrolled off the ring still fires). ALERT.
+* ``capacity_grow_frequency`` — more than ``max_grows`` capacity/halo
+  grows within the retained window: capacities are thrashing instead of
+  converging to the workload. WARN.
+* ``imbalance_ratio`` — the latest ``flow_snapshot``'s max/mean
+  population gauge above ``threshold``. WARN.
+* ``step_time_spike`` — the latest ``step_time`` event above ``factor``
+  x the EMA of the preceding ones (feed :meth:`HealthMonitor.note_step_time`
+  from the driver's timing loop). WARN.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+from mpi_grid_redistribute_tpu.telemetry.recorder import StepRecorder
+
+OK = "OK"
+WARN = "WARN"
+ALERT = "ALERT"
+_SEVERITY_ORDER = {OK: 0, WARN: 1, ALERT: 2}
+
+
+class HealthRule(NamedTuple):
+    """One declarative rule: ``fn(recorder)`` returns a human reason
+    string when the rule fires, ``None`` when healthy. ``severity`` is
+    :data:`WARN` or :data:`ALERT`."""
+
+    name: str
+    severity: str
+    fn: Callable[[StepRecorder], Optional[str]]
+
+
+class Finding(NamedTuple):
+    """One fired rule from a :meth:`HealthMonitor.evaluate` pass."""
+
+    rule: str
+    severity: str
+    reason: str
+
+
+def backlog_growth(window: int = 4) -> HealthRule:
+    """ALERT when total backlog grows strictly monotonically over the
+    last ``window`` ``migrate_step`` events (and ends nonzero)."""
+    if window < 2:
+        raise ValueError(f"window must be >= 2, got {window}")
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        ev = rec.events("migrate_step")[-window:]
+        if len(ev) < window:
+            return None
+        backlog = [int(e.data.get("backlog", 0)) for e in ev]
+        growing = all(b > a for a, b in zip(backlog, backlog[1:]))
+        if growing and backlog[-1] > 0:
+            return (
+                f"backlog grew monotonically over the last {window} "
+                f"steps: {backlog[0]} -> {backlog[-1]}"
+            )
+        return None
+
+    return HealthRule("backlog_growth", ALERT, fn)
+
+
+def dropped_rows() -> HealthRule:
+    """ALERT on any surfaced row loss: a ``migrate_step`` event with
+    ``dropped_recv > 0``, or any all-time ``overflow_window_loss``."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        losses = rec.counts().get("overflow_window_loss", 0)
+        if losses:
+            return f"{losses} overflow window(s) resolved with loss"
+        for e in rec.events("migrate_step"):
+            d = int(e.data.get("dropped_recv", 0))
+            if d > 0:
+                return f"dropped_recv={d} at step {e.data.get('step')}"
+        return None
+
+    return HealthRule("dropped_rows", ALERT, fn)
+
+
+def capacity_grow_frequency(max_grows: int = 3) -> HealthRule:
+    """WARN when more than ``max_grows`` capacity/halo grow events are
+    retained in the ring — capacities are thrashing, not converging."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        grows = len(rec.events("capacity_grow")) + len(
+            rec.events("halo_grow")
+        )
+        if grows > max_grows:
+            return (
+                f"{grows} capacity grows in the retained window "
+                f"(> {max_grows}): workload outruns the size estimate"
+            )
+        return None
+
+    return HealthRule("capacity_grow_frequency", WARN, fn)
+
+
+def imbalance_ratio(threshold: float = 2.0) -> HealthRule:
+    """WARN when the latest ``flow_snapshot`` population imbalance
+    (max/mean) exceeds ``threshold``."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        e = rec.last("flow_snapshot")
+        if e is None:
+            return None
+        imb = float(e.data.get("imbalance", 0.0))
+        if imb > threshold:
+            return (
+                f"population imbalance {imb:.2f}x (max/mean) exceeds "
+                f"{threshold:.2f}x"
+            )
+        return None
+
+    return HealthRule("imbalance_ratio", WARN, fn)
+
+
+def step_time_spike(factor: float = 3.0, min_samples: int = 4) -> HealthRule:
+    """WARN when the newest ``step_time`` event exceeds ``factor`` x the
+    EMA of the preceding ones (recompile, contention, thermal event)."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        ev = rec.events("step_time")
+        if len(ev) < min_samples:
+            return None
+        times = [float(e.data.get("seconds", 0.0)) for e in ev]
+        ema = times[0]
+        for t in times[1:-1]:
+            ema = 0.2 * t + 0.8 * ema
+        if ema > 0 and times[-1] > factor * ema:
+            return (
+                f"step time {times[-1] * 1e3:.2f} ms is "
+                f"{times[-1] / ema:.1f}x the {ema * 1e3:.2f} ms EMA"
+            )
+        return None
+
+    return HealthRule("step_time_spike", WARN, fn)
+
+
+def default_rules() -> List[HealthRule]:
+    return [
+        backlog_growth(),
+        dropped_rows(),
+        capacity_grow_frequency(),
+        imbalance_ratio(),
+        step_time_spike(),
+    ]
+
+
+class HealthMonitor:
+    """Evaluate declarative rules against a recorder's journal.
+
+    ``monitor.evaluate()`` runs every rule, records one ``alert`` event
+    per NEW finding into the same ring (deduplicated: the same
+    (rule, reason) pair is not re-journaled until new events arrive),
+    invokes the registered callbacks with each new :class:`Finding`, and
+    returns ``{"status": OK|WARN|ALERT, "findings": [...]}`` — the dict
+    behind ``GridRedistribute.health()``.
+    """
+
+    def __init__(
+        self,
+        recorder: StepRecorder,
+        rules: Optional[Sequence[HealthRule]] = None,
+        on_alert: Optional[Callable[[Finding], None]] = None,
+    ):
+        self.recorder = recorder
+        self.rules = list(default_rules() if rules is None else rules)
+        self.callbacks: List[Callable[[Finding], None]] = []
+        if on_alert is not None:
+            self.callbacks.append(on_alert)
+        # (rule name) -> (reason, journal seq at fire time): dedup state
+        self._seen: Dict[str, object] = {}
+
+    def add_callback(self, cb: Callable[[Finding], None]) -> None:
+        self.callbacks.append(cb)
+
+    def note_step_time(self, seconds: float) -> None:
+        """Journal one measured step time (feeds ``step_time_spike``)."""
+        self.recorder.record("step_time", seconds=float(seconds))
+
+    def evaluate(self) -> Dict[str, object]:
+        findings: List[Finding] = []
+        # dedup clock: non-alert events ever journaled — the alert events
+        # this pass records must not count as "new evidence" for the next
+        rec = self.recorder
+        seq = rec.total_recorded - rec.counts().get("alert", 0)
+        for rule in self.rules:
+            reason = rule.fn(rec)
+            if reason is None:
+                self._seen.pop(rule.name, None)
+                continue
+            f = Finding(rule.name, rule.severity, reason)
+            findings.append(f)
+            if self._seen.get(rule.name) == (reason, seq):
+                continue  # same finding, no new events: don't re-journal
+            rec.record(
+                "alert",
+                rule=rule.name,
+                severity=rule.severity,
+                reason=reason,
+            )
+            self._seen[rule.name] = (reason, seq)
+            for cb in self.callbacks:
+                cb(f)
+        status = OK
+        for f in findings:
+            if _SEVERITY_ORDER[f.severity] > _SEVERITY_ORDER[status]:
+                status = f.severity
+        return {
+            "status": status,
+            "findings": [f._asdict() for f in findings],
+        }

@@ -141,7 +141,10 @@ class StepRecorder:
 
 
 def record_migrate_steps(
-    recorder: StepRecorder, stats, max_steps: Optional[int] = None
+    recorder: StepRecorder,
+    stats,
+    max_steps: Optional[int] = None,
+    rank_totals: bool = False,
 ) -> int:
     """Feed a step-stacked ``MigrateStats`` into ``recorder`` as one
     ``migrate_step`` event per step (sent/received/backlog/dropped/
@@ -149,16 +152,40 @@ def record_migrate_steps(
     stats come back as ``[S, R]`` device arrays — to the host journal;
     calling it forces ONE host transfer of the (tiny) stats pytree, so
     call it where the bench drivers already read stats, not inside a hot
-    loop. ``max_steps`` keeps only the trailing window. Returns the
-    number of events recorded."""
+    loop. ``max_steps`` keeps only the trailing window.
+    ``rank_totals=True`` additionally records the per-rank vectors
+    (``sent_per_rank``/``received_per_rank``/``population_per_rank``
+    lists) each step — the per-rank view the flow path's imbalance rules
+    consume. Returns the number of events recorded.
+
+    Every counter leaf must have the same shape as ``sent`` — a
+    mismatched hand-built pytree raises a named ValueError here instead
+    of silently reshaping into wrong per-step totals (or dying in numpy
+    with an opaque broadcast error)."""
     sent = np.asarray(stats.sent)
     sent = sent.reshape(-1, sent.shape[-1])
-    recv = np.asarray(stats.received).reshape(sent.shape)
-    backlog = np.asarray(stats.backlog).reshape(sent.shape)
-    dropped = np.asarray(stats.dropped_recv).reshape(sent.shape)
-    pop = np.asarray(stats.population).reshape(sent.shape)
+    leaves = {}
+    for name in ("received", "backlog", "dropped_recv", "population"):
+        a = np.asarray(getattr(stats, name))
+        if a.size != sent.size:
+            raise ValueError(
+                f"MigrateStats.{name} has shape {a.shape} "
+                f"({a.size} elements) but sent has shape "
+                f"{np.asarray(stats.sent).shape} ({sent.size} elements) "
+                f"— stats leaves must be shape-congruent per step"
+            )
+        leaves[name] = a.reshape(sent.shape)
+    recv, backlog = leaves["received"], leaves["backlog"]
+    dropped, pop = leaves["dropped_recv"], leaves["population"]
     start = 0 if max_steps is None else max(0, sent.shape[0] - max_steps)
     for s in range(start, sent.shape[0]):
+        extra = {}
+        if rank_totals:
+            extra = {
+                "sent_per_rank": [int(x) for x in sent[s]],
+                "received_per_rank": [int(x) for x in recv[s]],
+                "population_per_rank": [int(x) for x in pop[s]],
+            }
         recorder.record(
             "migrate_step",
             step=s,
@@ -167,5 +194,6 @@ def record_migrate_steps(
             backlog=int(backlog[s].sum()),
             dropped_recv=int(dropped[s].sum()),
             population=int(pop[s].sum()),
+            **extra,
         )
     return sent.shape[0] - start

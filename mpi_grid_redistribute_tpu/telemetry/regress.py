@@ -42,6 +42,27 @@ GUARDED_METRICS: Dict[str, str] = {
     "value": "higher",        # particles/sec/chip — the headline
     "ms_per_step": "lower",
     "exchange_bytes_per_sec": "higher",
+    # the BASELINE metric's second head: achieved fraction of the
+    # exchange-domain roof. Guarded so a refactor cannot silently trade
+    # wire efficiency for pps (same rows at lower utilization = the step
+    # got slower elsewhere). r01/r02 predate the field -> skipped there.
+    "exchange_bw_util": "higher",
+    # the stress capture's bw_util: the headline workload is
+    # compute-bound at 2% migration, so only the nested full-reshuffle
+    # stress run (bench.py "stress" key <- config7_stress) says whether
+    # the exchange itself kept its roof-side headroom. Skipped against
+    # captures that predate the stress field.
+    "stress_bw_util": "higher",
+}
+
+# nested fallbacks: a metric missing at the top level of the parsed
+# bench line is pulled from a nested dict instead — newer captures carry
+# the merged exchange_report under "report" (unprefixed keys) and the
+# full-reshuffle capture under "stress"
+_NESTED_KEYS: Dict[str, Tuple[str, str]] = {
+    "exchange_bw_util": ("report", "bw_util"),
+    "exchange_bytes_per_sec": ("report", "exchange_bytes_per_sec"),
+    "stress_bw_util": ("stress", "bw_util"),
 }
 
 
@@ -81,6 +102,11 @@ def extract_metrics(capture: dict) -> Optional[Dict[str, float]]:
     out = {}
     for name in GUARDED_METRICS:
         v = parsed.get(name)
+        if v is None and name in _NESTED_KEYS:
+            outer, inner = _NESTED_KEYS[name]
+            nested = parsed.get(outer)
+            if isinstance(nested, dict):
+                v = nested.get(inner)
         if isinstance(v, (int, float)):
             out[name] = float(v)
     return out

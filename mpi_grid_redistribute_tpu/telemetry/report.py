@@ -19,6 +19,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from mpi_grid_redistribute_tpu.telemetry import flow as flow_lib
 from mpi_grid_redistribute_tpu.utils import profiling, stats as stats_lib
 
 
@@ -110,6 +111,18 @@ def exchange_report(
         out["exchange_bytes_per_sec"] = bps
         out["exchange_gb_per_sec"] = bps / 1e9
         out["bw_util"] = profiling.exchange_bw_util(bps, domain, n_chips)
+    # per-link refinement (telemetry.flow): mean per-step flow matrix ->
+    # hottest pairs with per-link moved bytes and bw_util against ONE
+    # link's roof. Aggregate-only stats (a hand-built MigrateStats with
+    # flow=None) simply omit the section.
+    try:
+        mean_matrix = flow_lib.flow_matrix_of(stats).mean(axis=0)
+    except (ValueError, TypeError):
+        mean_matrix = None
+    if mean_matrix is not None:
+        out["links"] = flow_lib.link_report(
+            mean_matrix, row_bytes, step_seconds=step_seconds, domain=domain
+        )
     if recorder is not None:
         out["events"] = recorder.counts()
         out["events_evicted"] = recorder.evicted

@@ -1,0 +1,169 @@
+"""Perfetto/Chrome-trace export of the telemetry journal.
+
+One command turns any bench run into a viewable timeline: the JSON this
+module emits loads in Perfetto (ui.perfetto.dev) or ``chrome://tracing``
+— the standard Trace Event Format (``{"traceEvents": [...]}``, each
+event carrying ``ph``/``ts``/``pid``/``tid``/``name``).
+
+Three track families:
+
+* **Journal instants** (pid 0): every retained
+  :class:`~.recorder.StepRecorder` event becomes an instant event
+  (``ph="i"``) on a per-kind track (one ``tid`` per event kind, labeled
+  with thread-name metadata), timestamped with the event's host wall
+  time relative to the first retained event. ``alert`` events land on
+  their own track next to the events that caused them.
+* **Phase spans** (pid 1): :class:`~.phases.PhaseTiming` rows (the
+  knockout / ``attribute_phases`` output) become duration events
+  (``ph="X"``) laid end to end — each phase's span length is its
+  attributed ``delta_s``, so the lane reads as one step's time budget.
+* **Migrate counters** (pid 2): ``migrate_step`` journal events become
+  counter tracks (``ph="C"``) for population, backlog, sent — the
+  timeline view of the drift workload unbalancing. Step events are
+  journaled in one batch (their wall times are all equal), so this
+  track uses SYNTHETIC time: ``step * step_seconds`` (default 1 ms per
+  step; pass the measured per-step seconds for an honest axis).
+
+``scripts/trace_export.py`` is the CLI wrapper;
+``GridRedistribute.to_perfetto()`` exports an API instance's journal.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+_TRACK_FAMILIES = {
+    0: "journal (instant events per kind)",
+    1: "phase attribution (duration events)",
+    2: "migrate steps (counter tracks, synthetic time)",
+}
+
+
+def _meta(pid: int, tid: int, what: str, name: str) -> Dict[str, object]:
+    return {
+        "ph": "M",
+        "pid": pid,
+        "tid": tid,
+        "name": what,
+        "args": {"name": name},
+    }
+
+
+def _json_safe(v):
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+def to_chrome_trace(
+    recorder=None,
+    phase_timings: Optional[Sequence] = None,
+    step_seconds: Optional[float] = None,
+) -> Dict[str, object]:
+    """Build one Trace Event Format dict from telemetry sources.
+
+    Args:
+      recorder: a :class:`~.recorder.StepRecorder`; its retained events
+        become instant events (pid 0) and its ``migrate_step`` events
+        additionally feed the counter tracks (pid 2).
+      phase_timings: :class:`~.phases.PhaseTiming` rows
+        (``attribute_phases`` output) for the duration lane (pid 1).
+      step_seconds: honest per-step seconds for the counter track's
+        synthetic time axis (default 1 ms per step).
+
+    Returns a JSON-serializable dict; every event carries the required
+    ``ph``/``ts``/``pid`` keys (schema-checked in ``tests/test_flow.py``).
+    """
+    events: List[Dict[str, object]] = []
+    for pid, name in _TRACK_FAMILIES.items():
+        events.append(_meta(pid, 0, "process_name", name))
+
+    # --- pid 0: journal instants, one tid per kind --------------------
+    if recorder is not None:
+        journal = recorder.events()
+        t0 = journal[0].time if journal else 0.0
+        tids: Dict[str, int] = {}
+        for e in journal:
+            tid = tids.setdefault(e.kind, len(tids))
+            events.append(
+                {
+                    "name": e.kind,
+                    "ph": "i",
+                    "ts": (e.time - t0) * 1e6,  # us
+                    "pid": 0,
+                    "tid": tid,
+                    "s": "t",  # thread-scoped instant
+                    "args": {
+                        "seq": e.seq,
+                        **{k: _json_safe(v) for k, v in e.data.items()},
+                    },
+                }
+            )
+        for kind, tid in tids.items():
+            events.append(_meta(0, tid, "thread_name", kind))
+
+    # --- pid 1: phase-attribution duration lane -----------------------
+    if phase_timings:
+        events.append(_meta(1, 0, "thread_name", "phases"))
+        cursor = 0.0
+        for row in phase_timings:
+            dur = max(float(row.delta_s), 0.0) * 1e6
+            args: Dict[str, object] = {
+                "cumulative_s": float(row.cumulative_s),
+                "delta_s": float(row.delta_s),
+            }
+            if getattr(row, "logical_bytes", None) is not None:
+                args["logical_bytes"] = int(row.logical_bytes)
+            x = getattr(row, "x_roofline", None)
+            if x is not None:
+                args["x_roofline"] = float(x)
+            events.append(
+                {
+                    "name": str(row.phase),
+                    "ph": "X",
+                    "ts": cursor,
+                    "dur": dur,
+                    "pid": 1,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+            cursor += dur
+
+    # --- pid 2: migrate-step counter tracks (synthetic time) ----------
+    if recorder is not None:
+        dt_us = (step_seconds if step_seconds else 1e-3) * 1e6
+        events.append(_meta(2, 0, "thread_name", "migrate counters"))
+        for e in recorder.events("migrate_step"):
+            ts = float(e.data.get("step", 0)) * dt_us
+            for counter in ("population", "backlog", "sent"):
+                if counter in e.data:
+                    events.append(
+                        {
+                            "name": counter,
+                            "ph": "C",
+                            "ts": ts,
+                            "pid": 2,
+                            "tid": 0,
+                            "args": {counter: int(e.data[counter])},
+                        }
+                    )
+
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_trace(
+    path: str,
+    recorder=None,
+    phase_timings: Optional[Sequence] = None,
+    step_seconds: Optional[float] = None,
+) -> int:
+    """Write :func:`to_chrome_trace` JSON to ``path``; returns the number
+    of trace events written (metadata included)."""
+    trace = to_chrome_trace(
+        recorder, phase_timings=phase_timings, step_seconds=step_seconds
+    )
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])

@@ -429,6 +429,15 @@ def main():
         peak_bytes_per_sec=HBM_PEAK,
         progress=stream,
     )
+    # KNOCKOUT_JSON=file dumps the rows for scripts/trace_export.py
+    # --phases (the Perfetto duration lane of the attribution)
+    out_json = os.environ.get("KNOCKOUT_JSON")
+    if out_json:
+        import json
+
+        with open(out_json, "w") as f:
+            json.dump([r._asdict() for r in rows], f, indent=1)
+        print(f"wrote {out_json} ({len(rows)} phase rows)", file=sys.stderr)
 
 
 if __name__ == "__main__":

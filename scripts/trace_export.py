@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Export telemetry to a Perfetto/Chrome-trace JSON (`make observe`).
+
+Thin CLI over :mod:`mpi_grid_redistribute_tpu.telemetry.traceview`.
+Three input sources, combinable:
+
+* ``--journal FILE`` — a JSON Lines journal written by
+  ``StepRecorder.to_jsonl`` (or ``GridRedistribute.telemetry``); events
+  are re-hydrated and become the instant + counter tracks.
+* ``--phases FILE`` — a JSON list of phase rows as dumped by
+  ``KNOCKOUT_JSON=file scripts/knockout_stages.py`` (the
+  ``attribute_phases`` output); rows become the duration lane.
+* ``--demo`` — no artifacts handy: run a small in-process drift loop on
+  whatever devices exist and trace that journal.
+
+Examples:
+
+  # journal from a bench run -> trace
+  python scripts/trace_export.py --journal run.jsonl --out trace.json
+
+  # knockout attribution -> duration lane (same trace file)
+  KNOCKOUT_JSON=phases.json python scripts/knockout_stages.py
+  python scripts/trace_export.py --phases phases.json --out trace.json
+
+  # self-contained demo
+  python scripts/trace_export.py --demo --out trace.json
+
+Open the output at https://ui.perfetto.dev or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def load_journal(path: str):
+    """Re-hydrate a StepRecorder from a ``to_jsonl`` export."""
+    from mpi_grid_redistribute_tpu import telemetry
+
+    rec = telemetry.StepRecorder()
+    n_lines = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            kind = obj.pop("kind")
+            obj.pop("seq", None)
+            t = obj.pop("time", None)
+            rec.record(kind, **obj)
+            if t is not None:
+                # keep the original wall time so track timestamps are
+                # honest (record() stamped "now")
+                last = rec._ring[-1]
+                rec._ring[-1] = last._replace(time=float(t))
+            n_lines += 1
+    if n_lines == 0:
+        raise SystemExit(f"{path}: empty journal")
+    return rec
+
+
+def load_phases(path: str):
+    """Load phase rows dumped as JSON into PhaseTiming tuples."""
+    from mpi_grid_redistribute_tpu.telemetry import phases as phases_lib
+
+    with open(path) as f:
+        rows = json.load(f)
+    if not isinstance(rows, list):
+        raise SystemExit(f"{path}: expected a JSON list of phase rows")
+    out = []
+    for r in rows:
+        out.append(
+            phases_lib.PhaseTiming(
+                phase=r["phase"],
+                cumulative_s=float(r["cumulative_s"]),
+                delta_s=float(r["delta_s"]),
+                logical_bytes=(
+                    None
+                    if r.get("logical_bytes") is None
+                    else int(r["logical_bytes"])
+                ),
+                roofline_s=(
+                    None
+                    if r.get("roofline_s") is None
+                    else float(r["roofline_s"])
+                ),
+            )
+        )
+    return out
+
+
+def demo_recorder(steps: int = 16):
+    """Run a small drift loop and return its populated journal."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
+
+    from mpi_grid_redistribute_tpu import telemetry
+    from mpi_grid_redistribute_tpu.bench import common
+    from mpi_grid_redistribute_tpu.models import nbody
+    from mpi_grid_redistribute_tpu.domain import Domain
+
+    grid_shape = (2, 2, 2)
+    dev_grid, vgrid, mesh, _ = common.pick_layout(grid_shape)
+    rng = np.random.default_rng(0)
+    n_local = 1 << 11
+    pos, _, alive = common.uniform_state(grid_shape, n_local, 0.9, rng)
+    vel = (0.02 * (rng.random(pos.shape, dtype=np.float32) - 0.5)).astype(
+        np.float32
+    )
+    cfg = nbody.DriftConfig(
+        domain=Domain(0.0, 1.0, periodic=True), grid=dev_grid, dt=1.0,
+        capacity=max(64, n_local // 4), n_local=n_local,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, steps, vgrid=vgrid)
+    _, _, _, st = loop(
+        nbody.rows_to_planar(pos, mesh.size),
+        nbody.rows_to_planar(vel, mesh.size),
+        alive,
+    )
+    rec = telemetry.StepRecorder()
+    telemetry.record_migrate_steps(rec, st, rank_totals=True)
+    acc = telemetry.FlowAccumulator()
+    acc.update(st)
+    telemetry.record_flow_snapshot(rec, acc)
+    telemetry.HealthMonitor(rec).evaluate()
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--journal", type=str, default=None,
+                    help="StepRecorder JSONL export to re-hydrate")
+    ap.add_argument("--phases", type=str, default=None,
+                    help="JSON list of attribute_phases rows "
+                         "(KNOCKOUT_JSON=file scripts/knockout_stages.py)")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a small drift loop in-process and trace it")
+    ap.add_argument("--steps", type=int, default=16,
+                    help="demo drift steps (default 16)")
+    ap.add_argument("--step-seconds", type=float, default=None,
+                    help="measured per-step seconds for the counter "
+                         "track's synthetic time axis (default 1 ms)")
+    ap.add_argument("--out", type=str, required=True,
+                    help="output trace JSON path")
+    args = ap.parse_args(argv)
+
+    if not (args.journal or args.phases or args.demo):
+        ap.error("nothing to export: give --journal, --phases, or --demo")
+
+    from mpi_grid_redistribute_tpu.telemetry import traceview
+
+    rec = None
+    if args.journal:
+        rec = load_journal(args.journal)
+    elif args.demo:
+        rec = demo_recorder(steps=args.steps)
+    timings = load_phases(args.phases) if args.phases else None
+
+    n_ev = traceview.write_trace(
+        args.out, rec, phase_timings=timings,
+        step_seconds=args.step_seconds,
+    )
+    print(f"wrote {args.out} ({n_ev} trace events) — open at "
+          f"https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
