@@ -27,6 +27,11 @@ G004      planar-engine 32-bit row contract: ``fuse_fields`` /
 G005      Pallas kernel lint: every ``pl.pallas_call`` passes explicit
           ``grid`` and ``BlockSpec``s; kernels using ``pl.program_id``
           must bound-check derived indices.
+G006      mover-sparse cost contract: functions marked with a
+          ``# gridlint: fastpath-engine`` comment above their ``def``
+          must not call sort-family ops or ``take``/``take_along_axis``
+          with ``arange``/``iota``-derived indices — resident-scale
+          work silently reverts the sparse engine to dense cost.
 ========  ==============================================================
 
 Suppress a finding with a same-line comment ``# gridlint: disable=G00x``

@@ -35,6 +35,8 @@ _RULE_DOCS = {
     "guard (planar 32-bit row contract)",
     "G005": "pallas_call must pass explicit grid and BlockSpecs; "
     "program_id-derived indices must be bounded",
+    "G006": "no sorts or arange-indexed full-array takes inside "
+    "fastpath-engine-marked functions (mover-sparse cost contract)",
 }
 
 

@@ -30,7 +30,7 @@ import os
 import re
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-RULE_IDS = ("G001", "G002", "G003", "G004", "G005")
+RULE_IDS = ("G001", "G002", "G003", "G004", "G005", "G006")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*gridlint:\s*disable(?P<file>-file)?\s*=\s*"
@@ -707,6 +707,7 @@ def run_gridlint(
     # rule modules register on import
     from mpi_grid_redistribute_tpu.analysis import (  # noqa: F401
         rules_collectives,
+        rules_fastpath,
         rules_jit,
         rules_pallas,
         rules_planar,

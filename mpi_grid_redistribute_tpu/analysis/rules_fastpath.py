@@ -1,0 +1,122 @@
+"""G006 — no resident-scale ops inside fast-path-marked functions.
+
+The mover-sparse migrate engine (ISSUE 4) exists to make the per-step
+redistribute cost scale with the MOVERS, not the residents: the fast
+branch may touch the ``[V, mover_cap]`` block and O(V) control arrays,
+never the full ``[K, V*n]`` state beyond one bounded gather/scatter. A
+single ``lax.sort`` or ``jnp.take(..., arange(n))`` slipped into that
+branch silently reverts the engine to O(n log^2 n) while every test
+still passes bit-for-bit — the worst kind of regression, invisible to
+correctness suites and only caught at scale.
+
+A function opts into the contract with a marker comment on the line
+directly above its ``def`` (above decorators, if any)::
+
+    # gridlint: fastpath-engine
+    def _fast_branch():
+        ...
+
+Inside a marked function (lexically, nested defs and lambdas included —
+they trace when the branch traces) the rule flags:
+
+* any sort-family call — ``sort`` / ``argsort`` / ``lexsort`` /
+  ``sort_key_val`` / ``top_k`` (jnp, lax, np spellings alike): sorts
+  are how resident-scale cost re-enters; the selection sorts the fast
+  path depends on live OUTSIDE the cond, in the shared prefix;
+* ``take`` / ``take_along_axis`` whose index argument is built from an
+  ``arange`` / ``iota`` — the full-array-gather idiom (a dense
+  permutation in disguise). Gathers at plan-shaped index arrays passed
+  in as values are fine: their width is the plan's, not the residents'.
+
+Like G001's branch-function scan the check is lexical only — a helper
+CALLED from the branch is not scanned. That is deliberate: helpers
+shared with the dense engine (``_land_scatter``, ``_stack_push_pop``)
+are size-generic, and the jaxpr walk in ``tests/test_migrate_sparse.py``
+is the dynamic backstop that sees through every call boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    Project,
+    call_name,
+    get_arg,
+    last_attr,
+    rule,
+)
+
+_MARKER_RE = re.compile(r"#\s*gridlint:\s*fastpath-engine\b")
+_SORT_NAMES = ("sort", "argsort", "lexsort", "sort_key_val", "top_k")
+_TAKE_NAMES = ("take", "take_along_axis")
+_IOTA_NAMES = ("arange", "iota", "broadcasted_iota")
+
+
+def _is_marked(fi, mod) -> bool:
+    node = fi.node
+    if isinstance(node, ast.Lambda):
+        return False
+    first = min(
+        [node.lineno] + [d.lineno for d in node.decorator_list]
+    )
+    if first < 2 or first - 2 >= len(mod.lines):
+        return False
+    return bool(_MARKER_RE.search(mod.lines[first - 2]))
+
+
+def _index_has_iota(idx: ast.AST) -> bool:
+    for sub in ast.walk(idx):
+        if isinstance(sub, ast.Call) and last_attr(
+            call_name(sub)
+        ) in _IOTA_NAMES:
+            return True
+    return False
+
+
+@rule("G006")
+def check_fastpath(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        for fi in mod.functions.values():
+            if not _is_marked(fi, mod):
+                continue
+            for call in ast.walk(fi.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                tail = last_attr(call_name(call))
+                if tail in _SORT_NAMES:
+                    findings.append(
+                        Finding(
+                            "G006",
+                            mod.relpath,
+                            call.lineno,
+                            call.col_offset,
+                            f"{tail} inside fastpath-engine-marked "
+                            f"function — sorts are resident-scale; the "
+                            f"fast branch must consume selections made "
+                            f"outside the cond",
+                            fi.qualname,
+                        )
+                    )
+                elif tail in _TAKE_NAMES:
+                    idx = get_arg(call, 1, "indices")
+                    if idx is not None and _index_has_iota(idx):
+                        findings.append(
+                            Finding(
+                                "G006",
+                                mod.relpath,
+                                call.lineno,
+                                call.col_offset,
+                                f"{tail} with arange/iota-derived "
+                                f"indices inside fastpath-engine-marked "
+                                f"function — a full-array gather is a "
+                                f"dense permutation in disguise; index "
+                                f"with the mover plan instead",
+                                fi.qualname,
+                            )
+                        )
+    return findings

@@ -54,6 +54,55 @@ def _next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
+class MoverCapacity:
+    """Measured-need growth policy for the sparse migrate engine's
+    ``mover_cap`` — the same machinery the canonical path runs on
+    ``needed_capacity`` (ISSUE 4).
+
+    Host-side and loop-agnostic: fold each window's ``MigrateStats``
+    with :meth:`update`. The exact per-step mover count is ``sent +
+    backlog`` (granted sends plus held-back leavers); when its observed
+    peak exceeds the current cap, the cap ratchets to the next
+    power-of-two bucket (recompiles then track bucket crossings only,
+    like ``Redistributer._capacities``) and ``update`` returns True —
+    the caller rebuilds its loop, e.g. ``cfg = dataclasses.replace(cfg,
+    mover_cap=mc.value)`` + ``nbody.make_migrate_loop(cfg, ...)``.
+    Never shrinks (a slow drift of shrink/grow would thrash
+    recompiles). Each growth journals a ``mover_cap_grow`` event to the
+    optional :class:`..telemetry.StepRecorder` (telemetry/SCHEMA.md).
+    """
+
+    def __init__(self, initial: int, max_cap: int = None, recorder=None):
+        if int(initial) < 1:
+            raise ValueError(f"initial must be >= 1, got {initial}")
+        self.max_cap = None if max_cap is None else int(max_cap)
+        self.value = _next_pow2(int(initial))
+        if self.max_cap is not None:
+            self.value = min(self.value, self.max_cap)
+        self.recorder = recorder
+        self.grow_count = 0
+
+    def update(self, stats) -> bool:
+        """Fold one step's (or a stacked window's) MigrateStats; True
+        when ``value`` grew and the loop should be rebuilt."""
+        movers = np.asarray(stats.sent) + np.asarray(stats.backlog)
+        peak = int(movers.max()) if movers.size else 0
+        if peak <= self.value:
+            return False
+        new = _next_pow2(peak)
+        if self.max_cap is not None:
+            new = min(new, self.max_cap)
+        if new <= self.value:
+            return False
+        old, self.value = self.value, new
+        self.grow_count += 1
+        if self.recorder is not None:
+            self.recorder.record(
+                "mover_cap_grow", old=old, new=new, peak_movers=peak
+            )
+        return True
+
+
 def _planar_specs(positions, fields):
     """Per-array (trailing_shape, dtype, n_rows) specs for the planar
     engines, or ``None`` when any array is not 32-bit (the planar fused
@@ -374,10 +423,9 @@ class GridRedistribute:
         if int(check_every) < 1:
             raise ValueError(f"check_every must be >= 1, got {check_every}")
         self.check_every = int(check_every)
-        if engine not in ("auto", "planar", "rowmajor"):
+        if engine not in exchange.ENGINES:
             raise ValueError(
-                f"engine must be 'auto', 'planar' or 'rowmajor', "
-                f"got {engine!r}"
+                f"engine must be one of {exchange.ENGINES}, got {engine!r}"
             )
         self.engine = engine
         # deferred-check state for 'grow' (see class docstring): number of
@@ -535,15 +583,25 @@ class GridRedistribute:
                 exchange.RedistributeStats(**stats),
             )
         specs = None
-        if self.engine in ("auto", "planar"):
+        if self.engine in ("auto", "planar", "sparse"):
             specs = _planar_specs(positions, fields)
-            if specs is None and self.engine == "planar":
+            if specs is None and self.engine in ("planar", "sparse"):
                 raise TypeError(
-                    "engine='planar' requires 32-bit positions and fields "
-                    "(they ride bitcast to float32 rows); cast or use "
-                    "engine='auto'/'rowmajor'"
+                    f"engine={self.engine!r} requires 32-bit positions and "
+                    "fields (they ride bitcast to float32 rows); cast or "
+                    "use engine='auto'/'rowmajor'"
                 )
-        if specs is not None:
+        # ONE dispatch rule, shared with the migrate loop
+        # (exchange.resolve_engine). 'sparse' resolves to the planar
+        # canonical engine here: the canonical output contract (MPI
+        # Alltoallv receive order) re-packs every resident row each call,
+        # so the O(movers) fast path only exists on the resident-slot
+        # migrate loop (models.nbody.make_migrate_loop + MoverCapacity).
+        resolved = exchange.resolve_engine(
+            self.engine, vranks=self._vranks,
+            planar_ok=specs is not None, canonical=True,
+        )
+        if resolved == "planar" and specs is not None:
             # The planar [K, n] engines: the repo's fastest canonical path
             # (BENCH_CONFIGS.md config 1), bit-identical to the row-major
             # engines and the oracle.

@@ -85,6 +85,11 @@ def run(
 
     rec = telemetry.StepRecorder()
     telemetry.record_migrate_steps(rec, _out[3], rank_totals=True)
+    # sparse fast path (ISSUE 4): the default engine='auto' config routes
+    # through the mover-sparse engine on single-chip vrank layouts; the
+    # fast_path leaf is absent (None) on multi-chip/dense builds
+    if _out[3].fast_path is not None:
+        telemetry.record_fast_path_steps(rec, _out[3])
     acc = telemetry.FlowAccumulator()
     acc.update(_out[3])
     telemetry.record_flow_snapshot(rec, acc)
@@ -102,6 +107,9 @@ def run(
         "health": verdict,
         "flow": acc.snapshot(k=5),
     }
+    hit = telemetry.fast_path_hit_rate(rec)
+    if hit is not None:
+        res["fast_path_hit_rate"] = round(hit, 4)
     if bias:
         res["metric"] = "config4_drift_bias_pps_per_chip"
         res["bias"] = True

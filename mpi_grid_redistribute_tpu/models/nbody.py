@@ -59,6 +59,17 @@ class DriftConfig:
     # fixes the vrank count. See shard_migrate_vranks_fn.
     cells: Optional[ProcessGrid] = None
     assignment: Optional[Tuple[int, ...]] = None
+    # migrate-loop engine selection (parallel.exchange.resolve_engine):
+    # "auto" picks the mover-sparse fast path when eligible (vgrid on a
+    # single device — see shard_migrate_vranks_fn), "sparse" asks for it
+    # explicitly (silently dense when ineligible), "planar" forces the
+    # dense engine.
+    engine: str = "auto"
+    # static mover-block width for the sparse fast path (rows a vrank
+    # may send per step through the O(movers) branch; None -> the
+    # resolved local_budget). Grow on sustained fallbacks via
+    # api.MoverCapacity.
+    mover_cap: Optional[int] = None
 
 
 def make_drift_step(cfg: DriftConfig, mesh: Mesh):
@@ -199,10 +210,12 @@ def make_migrate_step(cfg: DriftConfig, mesh: Mesh):
         return pos, vel, alive, stats, rho
 
     # scalar-per-shard leaves stack on the shard axis -> global [R]; the
-    # flow leaf is a [1, R] row per shard -> global [R, R] (rows sharded)
+    # flow leaf is a [1, R] row per shard -> global [R, R] (rows sharded);
+    # the flat engine carries no sparse path, so fast_path stays None
     stats_spec = migrate.MigrateStats(
-        *([spec] * (len(migrate.MigrateStats._fields) - 1)),
+        *([spec] * (len(migrate.MigrateStats._fields) - 2)),
         flow=P(axes, None),
+        fast_path=None,
     )
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:
@@ -266,6 +279,7 @@ def make_migrate_loop(
     spec = P(axes)
     D = cfg.domain.ndim
     V = 1 if vgrid is None else vgrid.nranks
+    mover_cap = None  # set on the sparse-eligible vrank path below
     if vgrid is None:
         if cfg.assignment is not None or cfg.cells is not None:
             raise ValueError(
@@ -293,10 +307,24 @@ def make_migrate_loop(
                 "contiguous region — deposit on the canonical layout, "
                 "or use deposit_method='scan'/'mxu' on a single device"
             )
+        eng = exchange.resolve_engine(
+            cfg.engine, vranks=True, n_devices=cfg.grid.nranks
+        )
+        if eng == "sparse":
+            mover_cap = (
+                cfg.mover_cap
+                if cfg.mover_cap is not None
+                else (
+                    cfg.local_budget
+                    if cfg.local_budget is not None
+                    else vgrid.nranks * cfg.capacity
+                )
+            )
         mig = migrate.shard_migrate_vranks_fn(
             cfg.domain, cfg.grid, vgrid, cfg.capacity,
             local_budget=cfg.local_budget,
             cells=cfg.cells, assignment=cfg.assignment,
+            mover_cap=mover_cap,
         )
     # Fused Pallas drift+wrap+bin (round 4): one streaming pass replaces
     # the XLA drift chain AND the engine's binning (the knockout's 9x-
@@ -498,9 +526,12 @@ def make_migrate_loop(
     # stats leaves are [S, V] per shard (scan-stacked): shard axis 1. The
     # flow leaf is [S, V, R_total] per shard — vrank rows stack on axis 1
     # into the global [S, R_total, R_total] step-stacked flow matrix.
+    # fast_path is a [S, V] leaf exactly when the sparse engine was
+    # requested (mover_cap resolved above), matching the engine's pytree.
     stats_spec = migrate.MigrateStats(
-        *([P(None, axes)] * (len(migrate.MigrateStats._fields) - 1)),
+        *([P(None, axes)] * (len(migrate.MigrateStats._fields) - 2)),
         flow=P(None, axes, None),
+        fast_path=None if mover_cap is None else P(None, axes),
     )
     out_specs = (spec, spec, spec, stats_spec)
     if dep_fn is not None:

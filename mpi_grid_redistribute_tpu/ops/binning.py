@@ -406,6 +406,133 @@ def sorted_dest_counts_batched(dest, n_dest: int, *, chunk: int = 4096,
     return jax.lax.cond(ok, two_level, flat)
 
 
+def sparse_select_params(n: int, block: int, *, chunk: int = 4096):
+    """Derive ``(chunk, cap)`` for :func:`sorted_mover_block` from the row
+    width and the mover-block capacity.
+
+    Policy: shrink ``chunk`` below ``n`` (tiny CPU test meshes), then size
+    ``cap`` so a uniformly spread mover population at the full ``block``
+    density sits ~4x under the per-chunk guard; when the whole block fits
+    in half a chunk, raise ``cap`` to ``block`` so the guard is subsumed
+    by the leaver-count check (``leavers <= block`` implies every chunk's
+    leavers fit) and the fast path never falls back on clustering alone.
+    ``cap`` is clamped to ``chunk // 2`` so the candidate sort always
+    moves fewer bytes than the chunk sorts it follows.
+    """
+    while chunk >= max(2, n) and chunk > 8:
+        chunk //= 2
+    exp = max(1, -(-block * chunk // max(1, n)))
+    cap = 1 << (4 * exp - 1).bit_length()
+    if block <= chunk // 2:
+        cap = max(cap, 1 << max(0, block - 1).bit_length())
+    cap = max(1, min(cap, chunk // 2))
+    return chunk, cap
+
+
+def sparse_select_feasible(n: int, n_dest: int, *, chunk: int = 4096,
+                           cap: int = 512) -> bool:
+    """True when :func:`sorted_mover_block` can be built for this shape —
+    the same STATIC conditions under which :func:`sorted_dest_counts_batched`
+    takes its two-level path (packing headroom, pow2 chunk, selection
+    actually shrinking the problem, no ``MPI_GRID_SELECT=flat`` override).
+    Callers gate engine construction on this; the dynamic per-step guard
+    (a chunk overflowing ``cap``, movers overflowing the block) is the
+    ``ok`` scalar the builder returns."""
+    bN = max(1, (n - 1).bit_length())
+    bT = (chunk - 1).bit_length()
+    nc = -(-n // chunk)
+    return not (
+        chunk <= 0
+        or chunk & (chunk - 1)
+        or n_dest + 1 > (1 << (31 - bN))
+        or n_dest + 1 > (1 << (31 - bT))
+        or nc * cap >= n
+        or os.environ.get("MPI_GRID_SELECT") == "flat"
+    )
+
+
+def sorted_mover_block(dest, n_dest: int, block: int, *, chunk: int = 4096,
+                       cap: int = 512):
+    """Two-level leaver selection compacted to a DENSE MOVER BLOCK of
+    static width ``block`` — the front end of the mover-sparse migrate
+    engine (ISSUE 4).
+
+    Same chunk-sort / candidate-slice / packed-repack machinery as
+    :func:`sorted_dest_counts_batched`'s fast path (same exactness
+    argument: when no chunk overflows ``cap``, the repacked candidate
+    sort reproduces the stable (dest, position) order of the flat packed
+    sort bit-for-bit), but with NO internal ``lax.cond`` — the caller
+    owns the fallback, because only the caller can route the whole step
+    (selection + exchange + landing) to the dense engine in one branch.
+    Dead candidates pack as ``n_dest << bN`` with ZERO position bits, so
+    the extracted block's tail beyond the leavers is zeros without any
+    extra masking.
+
+    Args:
+      dest: [V, n] int32 destinations; sentinel ``n_dest`` = stayer.
+      n_dest: number of destinations.
+      block: static mover-block width (``mover_cap``).
+      chunk, cap: selection parameters; must satisfy
+        :func:`sparse_select_feasible` (raises ValueError otherwise).
+
+    Returns:
+      ``(block_rows [V, block], counts [V, n_dest], bounds [V, n_dest+1],
+      ok)`` — row indices of the leavers of each vrank in stable (dest,
+      position) order, zero-padded past the leaver count; exact counts
+      and segment bounds; and ``ok``, ONE scalar that is True iff no
+      chunk overflowed ``cap`` AND every vrank's leavers fit in
+      ``block``. When ``ok`` is False the other outputs are NOT
+      contractual (candidates may be missing movers) and the caller must
+      take its dense branch.
+    """
+    V, n = dest.shape
+    if not sparse_select_feasible(n, n_dest, chunk=chunk, cap=cap):
+        raise ValueError(
+            f"sorted_mover_block infeasible for n={n}, n_dest={n_dest}, "
+            f"chunk={chunk}, cap={cap} (gate on sparse_select_feasible)"
+        )
+    bN = max(1, (n - 1).bit_length())
+    bT = (chunk - 1).bit_length()
+    nc = -(-n // chunk)
+    npad = nc * chunk - n
+    ch = dest
+    if npad:
+        ch = jnp.concatenate(
+            [dest, jnp.full((V, npad), n_dest, jnp.int32)], axis=1
+        )
+    ch = ch.reshape(V, nc, chunk)
+    lc = jnp.sum((ch != n_dest).astype(jnp.int32), axis=-1)  # [V, nc]
+    iota_t = jnp.arange(chunk, dtype=jnp.int32)
+    packed1 = jax.lax.sort((ch << bT) | iota_t, dimension=-1, is_stable=False)
+    cand = jax.lax.slice_in_dim(packed1, 0, cap, axis=2)
+    dest_c = cand >> bT
+    pos_g = (
+        jnp.arange(nc, dtype=jnp.int32)[None, :, None] * chunk
+    ) | (cand & (chunk - 1))
+    live = (
+        jnp.arange(cap, dtype=jnp.int32)[None, None, :] < lc[:, :, None]
+    )
+    packed2 = jnp.where(live, (dest_c << bN) | pos_g, jnp.int32(n_dest << bN))
+    packed2 = jax.lax.sort(
+        packed2.reshape(V, nc * cap), dimension=-1, is_stable=False
+    )
+    order_c = packed2 & jnp.int32((1 << bN) - 1)
+    edges = jnp.arange(n_dest + 1, dtype=jnp.int32) << bN
+    bounds = jax.vmap(
+        lambda p: jnp.searchsorted(p, edges, side="left")
+    )(packed2).astype(jnp.int32)
+    counts = bounds[:, 1:] - bounds[:, :-1]
+    if block <= nc * cap:
+        block_rows = jax.lax.slice_in_dim(order_c, 0, block, axis=1)
+    else:
+        block_rows = jnp.zeros((V, block), jnp.int32).at[:, : nc * cap].set(
+            order_c
+        )
+    leavers = jnp.sum(counts, axis=1)
+    ok = (jnp.max(lc) <= cap) & (jnp.max(leavers) <= block)
+    return block_rows, counts, bounds, ok
+
+
 def bounds_dense(keys_sorted, n_edges: int, stride: int = 1,
                  key_bound: int = None):
     """``jnp.searchsorted(keys_sorted, arange(n_edges) * stride, 'left')``

@@ -293,6 +293,22 @@ def planar_compact_with_self(
     return out, new_count.astype(jnp.int32), dropped.astype(jnp.int32)
 
 
+def gather_plan_cols(fused: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather plan-addressed columns out of a planar matrix in ONE flat
+    1-D take: ``fused [K, W]`` gathered at ``idx [...]`` (flat column
+    indices into ``W``) -> ``[K, *idx.shape]``.
+
+    Shared by the migrate engines' arrival gathers (dense and
+    mover-sparse): a single flat gather with the index arithmetic done up
+    front lowers to one contiguous XLA gather, where the equivalent
+    multi-dim ``take`` emits a slower composite (same reason
+    :func:`pack_by_destination` pre-flattens its indices). Callers mask
+    invalid slots themselves — indices must already be clipped in-range.
+    """
+    flat = jnp.take(fused, idx.reshape(-1), axis=1)
+    return flat.reshape((fused.shape[0],) + idx.shape)
+
+
 def pack_cols(fused, order, bounds, send_counts, n_dest: int,
                capacity: int):
     """Gather the first ``send_counts[d]`` sorted columns of each

@@ -36,6 +36,60 @@ from mpi_grid_redistribute_tpu.ops import binning, pack
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
+ENGINES = ("auto", "planar", "rowmajor", "sparse")
+
+
+def resolve_engine(
+    engine: str,
+    *,
+    vranks: bool = False,
+    n_devices: int = 1,
+    planar_ok: bool = True,
+    canonical: bool = False,
+) -> str:
+    """Resolve a user-facing engine name to a concrete engine — the ONE
+    dispatch rule shared by :class:`..api.Redistributer` (canonical
+    exchange) and :func:`..models.nbody.make_migrate_loop` (resident-slot
+    migrate loop), so the two surfaces cannot drift.
+
+    Canonical exchange (``canonical=True``) returns ``"planar"`` or
+    ``"rowmajor"``: ``"auto"`` picks planar when the payload qualifies
+    (``planar_ok`` — 32-bit fields that ride bitcast); ``"sparse"``
+    resolves to planar because the canonical output contract (MPI
+    Alltoallv receive order) forces a full re-pack of every resident row
+    each call — an O(movers) step cannot exist there.
+
+    Migrate loop (``canonical=False``) returns ``"sparse"`` or
+    ``"planar"``: ``"auto"``/``"sparse"`` pick the mover-sparse fast
+    path exactly when the step is a single-device vrank step (``vranks``
+    and ``n_devices == 1`` — see
+    :func:`..parallel.migrate.shard_migrate_vranks_fn` for why
+    cross-device steps stay dense); ``"rowmajor"`` has no migrate-loop
+    meaning and raises.
+    """
+    if engine not in ENGINES:
+        raise ValueError(
+            f"engine must be one of {ENGINES}, got {engine!r}"
+        )
+    if canonical:
+        if engine == "rowmajor":
+            return "rowmajor"
+        # "auto"/"planar"/"sparse" -> planar when the payload qualifies;
+        # "auto" falls back to rowmajor otherwise ("planar" is an
+        # explicit ask — the caller surfaces the typed payload error)
+        if engine == "auto" and not planar_ok:
+            return "rowmajor"
+        return "planar"
+    if engine == "rowmajor":
+        raise ValueError(
+            "engine='rowmajor' is a canonical-exchange engine; the "
+            "migrate loop accepts 'auto', 'sparse' or 'planar'"
+        )
+    if engine in ("auto", "sparse") and vranks and n_devices == 1:
+        return "sparse"
+    return "planar"
+
+
 class RedistributeStats(NamedTuple):
     """Per-step observability (SURVEY.md §5.5). Global (post-shard_map)
     shapes: ``send_counts`` is [R, R] indexed [source, dest];

@@ -93,6 +93,9 @@ from jax import lax
 from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
 from mpi_grid_redistribute_tpu.ops import binning
 from mpi_grid_redistribute_tpu.ops.pack import pack_cols as _pack_cols
+from mpi_grid_redistribute_tpu.ops.pack import (
+    gather_plan_cols as _gather_plan_cols,
+)
 # mig:bin / mig:pack / mig:exchange / mig:unpack named scopes on the step
 # phases — XLA op metadata for Perfetto/XProf grouping (telemetry.phases)
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
@@ -210,7 +213,15 @@ class MigrateStats(NamedTuple):
     zero extra device work, zero host syncs. Row sums equal ``sent``
     and column sums equal ``received`` exactly (sends are
     receiver-granted, so the two sides agree by construction). Defaults
-    to ``None`` (an empty pytree leaf) for hand-built fixtures."""
+    to ``None`` (an empty pytree leaf) for hand-built fixtures.
+
+    ``fast_path`` (ISSUE 4) reports the mover-sparse engine's per-step
+    branch decision: [V] int32 per shard, 1 = the step ran the O(movers)
+    fast branch, 0 = the residence/overflow guard routed it to the dense
+    engine. ``None`` (the default, and what every non-sparse engine
+    emits) means the engine carries no sparse path at all — telemetry
+    distinguishes "no fast path built" from "built but fell back". The
+    step's mover count is derivable as ``sent + backlog``."""
 
     sent: jax.Array
     received: jax.Array
@@ -218,6 +229,7 @@ class MigrateStats(NamedTuple):
     backlog: jax.Array
     dropped_recv: jax.Array  # structurally 0 since receiver-granted sends
     flow: jax.Array = None  # [R, R] granted sends; None in old fixtures
+    fast_path: jax.Array = None  # [V] 1/0 sparse-branch taken; None = n/a
 
 
 class MigrateState(NamedTuple):
@@ -716,7 +728,7 @@ def _plan_rows(seg_starts, seg_counts, order, length: int):
 
 
 def _plan_rows_batched(seg_starts, seg_counts, order, length: int,
-                       seg_rows=None):
+                       seg_rows=None, row_stride: int = None):
     """Batched :func:`_plan_rows` over a leading vrank axis, with every
     gather LINEARIZED into one wide-minor ``jnp.take(..., axis=1)``.
 
@@ -739,9 +751,19 @@ def _plan_rows_batched(seg_starts, seg_counts, order, length: int,
     ``row_g * n`` add is O(V*M); pre-globalizing ``order`` instead
     would materialize an O(V*n) temp per step). Default: plan row v
     reads ``order[v]``, values raw.
+
+    ``row_stride`` (ISSUE 4 — the mover-sparse engine): the column
+    stride used to GLOBALIZE returned entries in ``seg_rows`` mode.
+    Defaults to ``order.shape[-1]``, which conflates two distinct
+    widths: the width of ``order`` (indexing) and the width of the
+    destination matrix the plan addresses (globalization). The sparse
+    fast path plans over a compacted ``[V, B]`` mover block whose
+    values index the full ``[K, V * n]`` resident matrix — there
+    ``order`` is B wide but the stride must stay ``n``.
     """
     V, S = seg_counts.shape
     n = order.shape[-1]
+    stride = n if row_stride is None else row_stride
     cum = jnp.concatenate(
         [
             jnp.zeros((V, 1), jnp.int32),
@@ -809,7 +831,7 @@ def _plan_rows_batched(seg_starts, seg_counts, order, length: int,
         order.reshape(1, -1), idx.reshape(-1), axis=1
     ).reshape(V, length)
     if seg_rows is not None:
-        vac = row_g * n + vac
+        vac = row_g * stride + vac
     return vac, cum[:, -1]
 
 
@@ -854,6 +876,7 @@ def shard_migrate_vranks_fn(
     cycle_rescue: bool = True,
     cells: ProcessGrid = None,
     assignment: tuple = None,
+    mover_cap: int = None,
 ):
     """Migration over a ``dev_grid * vgrid`` process grid, planar layout.
 
@@ -917,6 +940,27 @@ def shard_migrate_vranks_fn(
     rank ids and are untouched. This is the classic HPC answer to
     imbalance — balance the decomposition, not the buffers — in
     static-shape TPU form.
+
+    **Mover-sparse fast path** (``mover_cap``, ISSUE 4): at ~2%
+    migration the dense step still pays full-array sort/pack/landing
+    over every resident row. Passing ``mover_cap`` (a static mover
+    budget per vrank per step, e.g. ``local_budget``) builds a second
+    engine behind ONE scalar ``lax.cond``: the two-level selection
+    compacts the leavers into a dense ``[V, mover_cap]`` block
+    (:func:`..ops.binning.sorted_mover_block`), the grant tables are
+    computed on the [V, V] count matrices exactly as the dense engine
+    does, and when the residence/overflow guard holds — selection exact,
+    nothing clipped (zero backlog), movers and arrivals within
+    ``mover_cap`` — landing gathers and scatters only mover columns
+    while stayer rows are never touched. Guard-violating steps run the
+    dense engine unchanged; outputs are bit-identical either way (the
+    guard conditions make the dense plans collapse to the leaver prefix
+    the block reproduces). Only built at ``Dev == 1`` (cross-device
+    traffic is already mover-sparse and a cond'd collective would
+    deadlock); with ``mover_cap`` set the stats carry a ``fast_path``
+    [V] leaf (1 = fast branch taken) — ``None`` otherwise. Size
+    ``mover_cap`` like ``local_budget`` and grow it with
+    :class:`..api.MoverCapacity` on sustained fallbacks.
     """
     axes = dev_grid.axis_names
     V = vgrid.nranks
@@ -1038,451 +1082,634 @@ def shard_migrate_vranks_fn(
                 leaving, dest_dev * V + dest_v, R_total
             ).astype(jnp.int32)  # [V, n]
 
-        # NOTE a flat composite-key sort (one [V*n] sort replacing the V
-        # vmapped sorts) was measured and REJECTED: the vmapped
-        # sorted_dest_counts is 5.7 ms at 8x1M while the flat composite
-        # sort alone is 9.8 ms, and the boundary lookup it then needs —
-        # searchsorted(method="sort"), 72 queries over 8.4M keys — costs
-        # a pathological ~97 ms on this stack (scripts/microbench_sort.py).
-        # ALSO REJECTED (late round 4): lax.top_k with k = plan capacity
-        # on a packed descending key — the order below is only consumed
-        # up to the first `leavers` entries, so a truncated selection
-        # would suffice semantically, but top_k lowers 2-5.8x SLOWER
-        # than the full packed sort (both packing in-loop: 14.6 vs
-        # 2.5 ms at 8x1M, 111.2 vs 56.8 at 64x1M —
-        # scripts/microbench_topk.py); a Pallas stream compaction was
-        # sketched and dropped: within-chunk placement needs a [T, T]
-        # one-hot whose VPU construction (~275G elem ops at 64M) dwarfs
-        # the sort it would replace.
-        # Two-level leaver selection (binning.sorted_dest_counts_batched):
-        # chunk sorts + one small candidate sort reproduce the consumed
-        # leaver prefix bit-for-bit at ~2.4x the flat packed sort's speed
-        # (56.3 -> 23.6 ms at 64x1M, scripts/microbench_select.py); a
-        # scalar guard cond-routes dense steps to the flat sort.
-        # order is prefix-only (zero tail past the leavers; see
-        # sorted_dest_counts_batched) — reads below slice/mask at counts.
-        with traced_span("mig:bin"):
-            order, counts, bounds = binning.sorted_dest_counts_batched(
-                dest_key, R_total
-            )  # [V, n], [V, R_total], [V, R_total + 1]
-        leavers = jnp.sum(counts, axis=1).astype(jnp.int32)  # [V]
+        def _step(flat, free_stack, n_free, dest_key):
+            """One full DENSE redistribute step given a precomputed
+            destination key — the planar vranks engine, O(residents)
+            per step. Extracted as a closure so the mover-sparse fast
+            path (dispatch below) can route guard-violating steps here
+            through ONE scalar ``lax.cond``; without ``mover_cap`` it
+            is simply called directly (status quo)."""
+            # NOTE a flat composite-key sort (one [V*n] sort replacing the V
+            # vmapped sorts) was measured and REJECTED: the vmapped
+            # sorted_dest_counts is 5.7 ms at 8x1M while the flat composite
+            # sort alone is 9.8 ms, and the boundary lookup it then needs —
+            # searchsorted(method="sort"), 72 queries over 8.4M keys — costs
+            # a pathological ~97 ms on this stack (scripts/microbench_sort.py).
+            # ALSO REJECTED (late round 4): lax.top_k with k = plan capacity
+            # on a packed descending key — the order below is only consumed
+            # up to the first `leavers` entries, so a truncated selection
+            # would suffice semantically, but top_k lowers 2-5.8x SLOWER
+            # than the full packed sort (both packing in-loop: 14.6 vs
+            # 2.5 ms at 8x1M, 111.2 vs 56.8 at 64x1M —
+            # scripts/microbench_topk.py); a Pallas stream compaction was
+            # sketched and dropped: within-chunk placement needs a [T, T]
+            # one-hot whose VPU construction (~275G elem ops at 64M) dwarfs
+            # the sort it would replace.
+            # Two-level leaver selection (binning.sorted_dest_counts_batched):
+            # chunk sorts + one small candidate sort reproduce the consumed
+            # leaver prefix bit-for-bit at ~2.4x the flat packed sort's speed
+            # (56.3 -> 23.6 ms at 64x1M, scripts/microbench_select.py); a
+            # scalar guard cond-routes dense steps to the flat sort.
+            # order is prefix-only (zero tail past the leavers; see
+            # sorted_dest_counts_batched) — reads below slice/mask at counts.
+            with traced_span("mig:bin"):
+                order, counts, bounds = binning.sorted_dest_counts_batched(
+                    dest_key, R_total
+                )  # [V, n], [V, R_total], [V, R_total + 1]
+            leavers = jnp.sum(counts, axis=1).astype(jnp.int32)  # [V]
 
-        # ---- local allocation: [V_src, V_dst] on this device ----------
-        loc0 = me_dev * V
-        loc_counts = lax.dynamic_slice_in_dim(counts, loc0, V, axis=1)
-        loc_starts = lax.dynamic_slice_in_dim(bounds, loc0, V, axis=1)
-        # per-source budget M: prefix truncation in destination order
-        # (rel = each pair segment's offset within the source's local run)
+            # ---- local allocation: [V_src, V_dst] on this device ----------
+            loc0 = me_dev * V
+            loc_counts = lax.dynamic_slice_in_dim(counts, loc0, V, axis=1)
+            loc_starts = lax.dynamic_slice_in_dim(bounds, loc0, V, axis=1)
+            # per-source budget M: prefix truncation in destination order
+            # (rel = each pair segment's offset within the source's local run)
+            rel_start = loc_starts - loc_starts[:, :1]
+            rel_end = rel_start + loc_counts
+            eff = jnp.clip(
+                jnp.minimum(rel_end, M) - jnp.minimum(rel_start, M),
+                0,
+            ).astype(jnp.int32)
+
+            # remote sends first: they vacate slots independently of the local
+            # allocation, so they seed the receiver-capacity fixpoint. With
+            # Dev > 1 the sends are RECEIVER-GRANTED (lossless receive): the
+            # desired per-pair counts fly first, each destination vrank
+            # greedily grants within its pre-step free slots, the grants fly
+            # back, and only granted rows are packed — ungranted rows stay
+            # resident and retry (backlog). Remote arrivals are then
+            # structurally <= n_free and the remote landing never drops.
+            # (Unlike the flat path there is no cross-device swap financing —
+            # the remote landing pops free slots only — so mutually-full
+            # vranks on different devices trade through backlog.)
+            if Dev > 1:
+                desired_rem = jnp.minimum(counts, C).astype(jnp.int32)
+                g_ids = jnp.arange(R_total, dtype=jnp.int32)
+                is_local_g = (g_ids >= loc0) & (g_ids < loc0 + V)
+                desired_rem = jnp.where(
+                    is_local_g[None, :], 0, desired_rem
+                )  # [V_src, R_total]
+                # desired -> receiver (same transpose layout as the payload)
+                desired_t = desired_rem.reshape(V, Dev, V).transpose(1, 0, 2)
+                recv_desired = lax.all_to_all(
+                    desired_t, axes, split_axis=0, concat_axis=0, tiled=True
+                ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_dst, S_global]
+                grants = _greedy_alloc(
+                    recv_desired.T, jnp.maximum(n_free, 0)
+                ).T.astype(jnp.int32)  # [V_dst, S_global]
+                # grants -> sender (reverse layout)
+                grants_t = grants.reshape(V, Dev, V).transpose(1, 0, 2)
+                grants_back = lax.all_to_all(
+                    grants_t, axes, split_axis=0, concat_axis=0, tiled=True
+                ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_src, G_dst]
+                rem_sent_full = jnp.minimum(desired_rem, grants_back)
+                sent_remote = jnp.sum(rem_sent_full, axis=1).astype(jnp.int32)
+                # actual arrivals == my grants (greedy allocates within each
+                # source's desire, so grants <= recv_desired always)
+                recv_counts_rem = grants
+                n_in_rem = jnp.sum(recv_counts_rem, axis=1).astype(jnp.int32)
+            else:
+                sent_remote = jnp.zeros((V,), jnp.int32)
+                n_in_rem = jnp.zeros((V,), jnp.int32)
+
+            # Receiver capacity: arrivals may use current free slots PLUS slots
+            # vacated by the receiver's own sends this step — otherwise
+            # fully-occupied vranks that need to swap livelock. Sends depend on
+            # destination capacities (circular), so solve by monotone-increasing
+            # fixpoint, seeded with pairwise swaps (which are self-financing:
+            # each vrank's swap arrivals exactly equal its swap departures).
+            # Every truncation of the increasing orbit is safe: iteration t's
+            # arrivals <= n_free + sends(t-1) + remote <= n_free + actual sends.
+            swap = jnp.minimum(eff, eff.T).astype(jnp.int32)
+            # trim so swap arrivals fit the [M] arrival plan per dst, then
+            # re-symmetrize (min with transpose keeps column sums <= M and
+            # restores the self-financing arrivals == departures invariant)
+            swap = _greedy_alloc(
+                swap, jnp.full((V,), M, jnp.int32)
+            ).astype(jnp.int32)
+            swap = jnp.minimum(swap, swap.T)
+            res_eff = eff - swap
+            res = jnp.zeros_like(eff)
+            # free slots already promised to granted remote arrivals are off
+            # the table for local arrivals (remote lands after local and only
+            # pops the stack)
+            n_free_local = n_free - n_in_rem
+            for _ in range(V):
+                cap_res = jnp.minimum(
+                    M - jnp.sum(swap, axis=0),
+                    n_free_local + sent_remote + jnp.sum(res, axis=1),
+                ).astype(jnp.int32)
+                res = _greedy_alloc(res_eff, jnp.maximum(cap_res, 0)).astype(
+                    jnp.int32
+                )
+            allowed = swap + res  # [V_src, V_dst]
+            if cycle_rescue and (Dev == 1 or R_total > 128):
+                # drain full-vrank rotation cycles on THIS device (all the
+                # tables are local — no collective needed). A cycle is only
+                # forced if every member stays within the [M] arrival/send
+                # plans (+1 row); partial application would break the
+                # self-financing pairing, so the guard is per whole cycle.
+                # (Above 128 global ranks the global pass below is off —
+                # matching the flat engine's R^2 log R closure bound — and
+                # this per-device rescue is the remaining guarantee.)
+                pending_loc = (res_eff - res).astype(jnp.int32)
+                sends_zero = (
+                    jnp.sum(allowed, axis=1) + sent_remote
+                ) == 0
+                ok = (jnp.sum(allowed, axis=1) < M) & (
+                    jnp.sum(allowed, axis=0) < M
+                )
+                allowed = allowed + _cycle_rescue(
+                    pending_loc, sends_zero, ok
+                )
+            elif cycle_rescue:
+                # GLOBAL rescue (round-3 verdict item 6): a rotation cycle
+                # that SPANS devices has no swap financing in the grant
+                # phase (remote grants draw on free slots only), so at zero
+                # free slots it backlogs under the normal protocol. Gather
+                # the full pending matrix, run the same functional-graph
+                # closure the flat engine uses, and force one row per cycle
+                # edge. The forced arrivals are financed by the forced
+                # departures through the EXISTING landing machinery: a
+                # member's forced remote departure vacates a slot that the
+                # local landing phase pushes onto the free stack
+                # (n_push = n_sent - n_in_local), and the remote landing —
+                # which runs after — pops exactly that slot; local-edge
+                # forced arrivals land in the vacated-slot plan directly.
+                # Every tier stays lossless at zero holes.
+                pending_loc = (res_eff - res).astype(jnp.int32)
+                pending_rows = desired_rem - rem_sent_full  # local cols are 0
+                pending_rows = lax.dynamic_update_slice(
+                    pending_rows, pending_loc, (jnp.int32(0), loc0)
+                )  # [V, R_total]
+                sent_loc_v = jnp.sum(allowed, axis=1).astype(jnp.int32)
+                recv_loc_v = jnp.sum(allowed, axis=0).astype(jnp.int32)
+
+                def gat(x):
+                    return lax.all_gather(x, axes).reshape(
+                        (R_total,) + x.shape[1:]
+                    )
+
+                pending_g = gat(pending_rows)  # [R_total, R_total]
+                sends_zero_g = gat(sent_loc_v + sent_remote) == 0
+                sent_loc_g = gat(sent_loc_v)
+                recv_loc_g = gat(recv_loc_v)
+                rem_sent_g = gat(rem_sent_full)  # [R_total, R_total]
+                g_all = jnp.arange(R_total, dtype=jnp.int32)
+                succ_g = jnp.argmax(pending_g > 0, axis=1)
+                same_dev = (succ_g // V) == (g_all // V)
+                # per-member guard on ITS forced edge (v -> succ(v)); every
+                # cycle edge is thus checked via its sender. Local edge:
+                # sender's local-send plan AND receiver's [M] arrival plan
+                # have room. Remote edge: the (v, succ) pair buffer has a
+                # free slot (covers both ends; the arrival pops the slot the
+                # departure pushes).
+                ok_g = jnp.where(
+                    same_dev,
+                    (sent_loc_g < M) & (recv_loc_g[succ_g] < M),
+                    rem_sent_g[g_all, succ_g] < C,
+                )
+                F = _cycle_rescue(pending_g, sends_zero_g, ok_g)
+                F_rows = lax.dynamic_slice(
+                    F, (loc0, jnp.int32(0)), (V, R_total)
+                )  # my vranks' forced sends
+                F_loc = lax.dynamic_slice(F_rows, (jnp.int32(0), loc0), (V, V))
+                allowed = allowed + F_loc
+                is_local_g2 = (g_all >= loc0) & (g_all < loc0 + V)
+                F_rem = jnp.where(is_local_g2[None, :], 0, F_rows)
+                rem_sent_full = rem_sent_full + F_rem
+                sent_remote = jnp.sum(rem_sent_full, axis=1).astype(jnp.int32)
+                F_cols = lax.dynamic_slice(
+                    F, (jnp.int32(0), loc0), (R_total, V)
+                )  # forced arrivals into my vranks, by global source
+                F_cols_rem = jnp.where(is_local_g2[:, None], 0, F_cols)
+                recv_counts_rem = recv_counts_rem + F_cols_rem.T
+                n_in_rem = jnp.sum(recv_counts_rem, axis=1).astype(jnp.int32)
+            sent_local = jnp.sum(allowed, axis=1).astype(jnp.int32)
+            n_in_local = jnp.sum(allowed, axis=0).astype(jnp.int32)
+
+            # ---- remote sends: [Dev, V_src, V_dst, K, C] over ICI ---------
+            if Dev > 1:
+                # build the send buffer by index arithmetic + one flat column
+                # gather; global rank ids enumerate dev-major (columns
+                # 0..R_total-1 of the count/bound tables)
+                c_i = jnp.arange(C, dtype=jnp.int32)
+                cnt_sg = rem_sent_full  # [V_src, R_total]
+                start_sg = bounds[:, :R_total]
+                valid = c_i[None, None, :] < cnt_sg[:, :, None]
+                pos = start_sg[:, :, None] + c_i[None, None, :]
+                # flat 1-D take (same ~33 ns/elem batched-gather avoidance
+                # as the plan paths; take_along_axis with 2-D indices falls
+                # back to the slow lowering)
+                row = jnp.take(
+                    order.reshape(1, -1),
+                    (
+                        my_v[:, None] * n
+                        + jnp.clip(pos, 0, n - 1).reshape(V, -1)
+                    ).reshape(-1),
+                    axis=1,
+                ).reshape(V, Dev * V, C)
+                gsrc = my_v[:, None, None] * n + row
+                vals = jnp.take(flat, gsrc.reshape(-1), axis=1).reshape(
+                    K, V, Dev, V, C
+                )
+                send = jnp.where(
+                    valid.reshape(V, Dev, V, C)[None], vals, 0
+                )
+                # [K, V_src, Dev, V_dst, C] -> [Dev, V_src, V_dst, K, C]
+                send = send.transpose(2, 1, 3, 0, 4)
+                with traced_span("mig:exchange"):
+                    recv = lax.all_to_all(
+                        send, axes, split_axis=0, concat_axis=0, tiled=True
+                    )  # [Dev_src, V_src, V_dst, K, C]
+                    # per-dst pools: [V_dst, K, Dev_src * V_src * C]; arrival
+                    # counts (recv_counts_rem) were derived locally in the
+                    # grant phase — no extra counts exchange needed
+                    recv = recv.transpose(2, 3, 0, 1, 4).reshape(
+                        V, K, Dev * V * C
+                    )
+
+            n_sent = sent_local + sent_remote
+
+            # ---- vacated slots: all columns leaving each vrank ------------
+            # segments: V local pairs (prefix `allowed`) then, with Dev > 1,
+            # R_total global ranks (remote prefix `rem_sent_full`).
+            if Dev > 1:
+                seg_starts = jnp.concatenate(
+                    [loc_starts, bounds[:, :R_total]], axis=1
+                )
+                seg_counts = jnp.concatenate([allowed, rem_sent_full], axis=1)
+                vacated, _tot = _plan_rows_batched(
+                    seg_starts, seg_counts, order, P
+                )  # [V, P] (linearized — vmapped gathers cost ~33 ns/elem)
+            elif P <= n:
+                # UNCLIPPED fast path (single-device): stayers sort to the
+                # END (sentinel key R_total), so leavers are a PREFIX of
+                # sorted space grouped by dest, and `eff`'s budget cap is a
+                # prefix truncation — when the grant phase clips nothing
+                # (allowed == eff, the steady-state common case) the slow
+                # plan's positions reduce to pos[v, j] = j exactly, i.e.
+                # vacated IS order[:, :P]. The telescoped-einsum plan + its
+                # ~19 ns/element order[pos] take (round-4 north-star
+                # knockout: +30 ms, the phase-4 floor) collapse to one
+                # slice. Entries beyond sum(allowed) differ between the
+                # branches but are never read (every consumer masks at
+                # k < n_sent). Clipped steps take the exact slow path.
+                if os.environ.get("MPI_GRID_VACATED_PLAN") == "slow":
+                    # diagnostic escape hatch (trace-time): force the general
+                    # plan to measure what the fast path saves in context
+                    vacated = _plan_rows_batched(
+                        loc_starts, allowed, order, P
+                    )[0]
+                else:
+                    unclipped = jnp.all(allowed == eff)
+                    vacated = lax.cond(
+                        unclipped,
+                        lambda: lax.slice_in_dim(order, 0, P, axis=1),
+                        lambda: _plan_rows_batched(
+                            loc_starts, allowed, order, P
+                        )[0],
+                    )
+            else:
+                vacated, _tot = _plan_rows_batched(
+                    loc_starts, allowed, order, P
+                )
+
+            # ---- local arrivals: one column gather sized to the budget ----
+            # dst w's arrivals: sources in order, first allowed[s, w] rows of
+            # each (s -> w) segment; arrival columns are globally indexed so
+            # one flat gather serves every vrank.
+            # dst w's plan walks SOURCE s's sorted space at segment (s -> w):
+            # same telescoped/flat-take machinery as the vacated plan
+            # (seg_rows maps segment s to order row s and globalizes the
+            # result to s * n + row; the vmapped `order[s, pos]` form this
+            # replaces pays the ~33 ns/element batched-gather toll — the
+            # round-4 knockout hid it inside the in-context landing phase).
+            with traced_span("mig:pack"):
+                arr_src, _ = _plan_rows_batched(
+                    loc_starts.T, allowed.T, order, M,
+                    seg_rows=jnp.arange(V, dtype=jnp.int32),
+                )  # [V_dst, M] global source columns
+                arr_cols = _gather_plan_cols(flat, arr_src)  # [K, V, M]
+
+            # ---- landing plan: one flat scatter for arrivals + holes ------
+            k_idx = jnp.arange(P, dtype=jnp.int32)
+
+            def land_plan(vac, nin, nsent, nf):
+                n_pop = jnp.clip(nin - nsent, 0, nf)
+                pop_idx = jnp.clip(nf - 1 - (k_idx - nsent), 0, n - 1)
+                target = jnp.where(
+                    k_idx < jnp.minimum(nin, nsent),
+                    vac,
+                    jnp.where(
+                        (k_idx >= nsent) & (k_idx < nsent + n_pop),
+                        jnp.zeros((), jnp.int32),  # replaced below (stack)
+                        jnp.where(
+                            (k_idx >= nin) & (k_idx < nsent), vac, n
+                        ),
+                    ),
+                )
+                return target, n_pop, pop_idx
+
+            targets, n_pop, pop_idx = jax.vmap(land_plan)(
+                vacated, n_in_local, n_sent, n_free
+            )
+            # The pop positions are an AFFINE sequence (stack head downward:
+            # nf-1, nf-2, ... for k in [nsent, nsent+n_pop)), so the gather
+            # is really a reversed contiguous window: slice it, reverse it,
+            # and shift it into k-alignment with one more dynamic slice —
+            # [P]-sized copies instead of a V*P-element random gather.
+            W2 = min(P, n)  # window length (P can exceed n in tiny tests)
+
+            def pops_window(fs_v, nf, nsent):
+                start = jnp.clip(nf - W2, 0, n - W2)
+                win_rev = lax.dynamic_slice(fs_v, (start,), (W2,))[::-1]
+                # win_rev[i] = fs_v[start + W2 - 1 - i]; want
+                # pops[k] = fs_v[nf - 1 - (k - nsent)] = win_rev[k + s],
+                # s = start + W2 - nf - nsent  (every in-use k lands inside
+                # the window; out-of-use entries read the zero pads and are
+                # masked by use_pop below)
+                s = start + W2 - nf - nsent
+                buf = jnp.concatenate(
+                    [
+                        jnp.zeros((P,), fs_v.dtype),
+                        win_rev,
+                        jnp.zeros((P,), fs_v.dtype),
+                    ]
+                )
+                return lax.dynamic_slice(buf, (s + P,), (P,))
+
+            pops = jax.vmap(pops_window)(free_stack, n_free, n_sent)
+            use_pop = (k_idx[None, :] >= n_sent[:, None]) & (
+                k_idx[None, :] < (n_sent + n_pop)[:, None]
+            )
+            targets = jnp.where(use_pop, pops, targets)
+            # global column ids; sentinel n -> out of range of [V*n] (dropped)
+            gtargets = jnp.where(
+                targets >= n, V * n, my_v[:, None] * n + targets
+            )
+            cols_w = jnp.zeros((K, V, P), flat.dtype).at[:, :, :M].set(
+                arr_cols
+            )
+            cols_w = jnp.where(
+                (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0
+            )
+            with traced_span("mig:unpack"):
+                flat = _land_scatter(
+                    flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
+                    scatter_impl,
+                )
+
+            # ---- free-stack update (contiguous window blend) --------------
+            n_push = jnp.maximum(n_sent - n_in_local, 0)
+            free_stack, n_free = jax.vmap(_stack_push_pop)(
+                free_stack, n_free, n_pop, n_push, vacated, n_in_local
+            )
+
+            # ---- remote landing: pops only, overflow counted --------------
+            if Dev > 1:
+                P_rem = Dev * V * C
+                kr = jnp.arange(P_rem, dtype=jnp.int32)
+
+                def land_remote(f, fs, nf, pool, rcnt):
+                    # f [K, n] (one vrank's columns), pool [K, P_rem]
+                    cum = jnp.concatenate(
+                        [jnp.zeros((1,), jnp.int32), jnp.cumsum(rcnt)]
+                    ).astype(jnp.int32)
+                    nin = cum[-1]
+                    # cum here has Dev*V + 1 entries (scales with the whole
+                    # machine): use the auto helper (merge-sort searchsorted
+                    # beyond O(tens) segments)
+                    s = jnp.clip(
+                        _segment_of_auto(kr, cum), 0, Dev * V - 1
+                    )
+                    src_slot = jnp.clip(
+                        s * C + (kr - cum[s]), 0, P_rem - 1
+                    )
+                    arrivals = jnp.take(pool, src_slot, axis=1)
+                    npop = jnp.minimum(nin, nf)
+                    dropped = (nin - npop).astype(jnp.int32)
+                    pop_i = jnp.clip(nf - 1 - kr, 0, n - 1)
+                    tgt = jnp.where(kr < npop, fs[pop_i], n)
+                    f = f.at[:, tgt].set(
+                        jnp.where((kr < nin)[None, :], arrivals, 0),
+                        mode="drop",
+                    )
+                    return f, nf - npop, nin, dropped
+
+                flat3, n_free, n_in_rem, dropped_recv = jax.vmap(
+                    land_remote,
+                    in_axes=(1, 0, 0, 0, 0),
+                    out_axes=(1, 0, 0, 0),
+                )(flat.reshape(K, V, n), free_stack, n_free, recv,
+                  recv_counts_rem)
+                flat = flat3.reshape(K, V * n)
+                received = n_in_local + n_in_rem
+            else:
+                dropped_recv = jnp.zeros((V,), jnp.int32)
+                received = n_in_local
+
+            backlog = (leavers - n_sent).astype(jnp.int32)
+            population = jnp.sum(
+                (flat[-1, :].reshape(V, n) > 0).astype(jnp.int32), axis=1
+            )
+            # my V rows of the global [R_total, R_total] flow matrix: remote
+            # granted sends with the local block overlaid (both tables are
+            # already live for the pack phase — pure stacking, no collective,
+            # no host sync). With Dev == 1 the local table IS the full matrix.
+            if Dev > 1:
+                flow_rows = lax.dynamic_update_slice(
+                    rem_sent_full, allowed, (jnp.int32(0), loc0)
+                )  # [V, R_total]
+            else:
+                flow_rows = allowed
+            stats = MigrateStats(
+                sent=n_sent,
+                received=received,
+                population=population,
+                backlog=backlog,
+                dropped_recv=dropped_recv,
+                flow=flow_rows,
+            )
+            return MigrateState(flat, free_stack, n_free), stats
+
+        # ---- engine dispatch: mover-sparse fast path (ISSUE 4) --------
+        # Built only when the caller passes ``mover_cap`` AND the whole
+        # grid lives on one device: cross-device traffic already rides a
+        # mover-sparse C-padded all_to_all, and a cond'd collective
+        # would deadlock unless every device took the same branch.
+        # Static infeasibility (selection cannot shrink the problem,
+        # packing overflow, MPI_GRID_SELECT=flat) also runs dense — with
+        # a [V] zeros ``fast_path`` leaf so the stats pytree is uniform
+        # for a given call signature.
+        B = None
+        if mover_cap is not None and Dev == 1:
+            B = max(1, min(int(mover_cap), n))
+            sel_chunk, sel_cap = binning.sparse_select_params(n, B)
+            if not binning.sparse_select_feasible(
+                n, R_total, chunk=sel_chunk, cap=sel_cap
+            ):
+                B = None
+        if B is None:
+            out_state, stats = _step(flat, free_stack, n_free, dest_key)
+            if mover_cap is not None:
+                stats = stats._replace(
+                    fast_path=jnp.zeros((V,), jnp.int32)
+                )
+            return out_state, stats
+
+        # ---- shared sparse prefix: O(movers) selection + grant tables -
+        # The two-level selection compacts the leavers into a dense
+        # [V, B] mover block (exact iff no chunk overflows sel_cap —
+        # the ``ok_sel`` scalar); the [V, V] grant fixpoint below is the
+        # verbatim dense-engine allocation (Dev == 1 terms only), so
+        # under the guard ``allowed_s`` IS the dense engine's ``allowed``.
+        with traced_span("mig:select"):
+            block_rows, s_counts, s_bounds, ok_sel = (
+                binning.sorted_mover_block(
+                    dest_key, R_total, B, chunk=sel_chunk, cap=sel_cap
+                )
+            )  # [V, B], [V, V], [V, V + 1] (R_total == V at Dev == 1)
+        loc_counts = s_counts
+        loc_starts = s_bounds[:, :V]
         rel_start = loc_starts - loc_starts[:, :1]
         rel_end = rel_start + loc_counts
         eff = jnp.clip(
-            jnp.minimum(rel_end, M) - jnp.minimum(rel_start, M),
-            0,
+            jnp.minimum(rel_end, M) - jnp.minimum(rel_start, M), 0
         ).astype(jnp.int32)
-
-        # remote sends first: they vacate slots independently of the local
-        # allocation, so they seed the receiver-capacity fixpoint. With
-        # Dev > 1 the sends are RECEIVER-GRANTED (lossless receive): the
-        # desired per-pair counts fly first, each destination vrank
-        # greedily grants within its pre-step free slots, the grants fly
-        # back, and only granted rows are packed — ungranted rows stay
-        # resident and retry (backlog). Remote arrivals are then
-        # structurally <= n_free and the remote landing never drops.
-        # (Unlike the flat path there is no cross-device swap financing —
-        # the remote landing pops free slots only — so mutually-full
-        # vranks on different devices trade through backlog.)
-        if Dev > 1:
-            desired_rem = jnp.minimum(counts, C).astype(jnp.int32)
-            g_ids = jnp.arange(R_total, dtype=jnp.int32)
-            is_local_g = (g_ids >= loc0) & (g_ids < loc0 + V)
-            desired_rem = jnp.where(
-                is_local_g[None, :], 0, desired_rem
-            )  # [V_src, R_total]
-            # desired -> receiver (same transpose layout as the payload)
-            desired_t = desired_rem.reshape(V, Dev, V).transpose(1, 0, 2)
-            recv_desired = lax.all_to_all(
-                desired_t, axes, split_axis=0, concat_axis=0, tiled=True
-            ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_dst, S_global]
-            grants = _greedy_alloc(
-                recv_desired.T, jnp.maximum(n_free, 0)
-            ).T.astype(jnp.int32)  # [V_dst, S_global]
-            # grants -> sender (reverse layout)
-            grants_t = grants.reshape(V, Dev, V).transpose(1, 0, 2)
-            grants_back = lax.all_to_all(
-                grants_t, axes, split_axis=0, concat_axis=0, tiled=True
-            ).transpose(2, 0, 1).reshape(V, Dev * V)  # [V_src, G_dst]
-            rem_sent_full = jnp.minimum(desired_rem, grants_back)
-            sent_remote = jnp.sum(rem_sent_full, axis=1).astype(jnp.int32)
-            # actual arrivals == my grants (greedy allocates within each
-            # source's desire, so grants <= recv_desired always)
-            recv_counts_rem = grants
-            n_in_rem = jnp.sum(recv_counts_rem, axis=1).astype(jnp.int32)
-        else:
-            sent_remote = jnp.zeros((V,), jnp.int32)
-            n_in_rem = jnp.zeros((V,), jnp.int32)
-
-        # Receiver capacity: arrivals may use current free slots PLUS slots
-        # vacated by the receiver's own sends this step — otherwise
-        # fully-occupied vranks that need to swap livelock. Sends depend on
-        # destination capacities (circular), so solve by monotone-increasing
-        # fixpoint, seeded with pairwise swaps (which are self-financing:
-        # each vrank's swap arrivals exactly equal its swap departures).
-        # Every truncation of the increasing orbit is safe: iteration t's
-        # arrivals <= n_free + sends(t-1) + remote <= n_free + actual sends.
         swap = jnp.minimum(eff, eff.T).astype(jnp.int32)
-        # trim so swap arrivals fit the [M] arrival plan per dst, then
-        # re-symmetrize (min with transpose keeps column sums <= M and
-        # restores the self-financing arrivals == departures invariant)
         swap = _greedy_alloc(
             swap, jnp.full((V,), M, jnp.int32)
         ).astype(jnp.int32)
         swap = jnp.minimum(swap, swap.T)
         res_eff = eff - swap
         res = jnp.zeros_like(eff)
-        # free slots already promised to granted remote arrivals are off
-        # the table for local arrivals (remote lands after local and only
-        # pops the stack)
-        n_free_local = n_free - n_in_rem
         for _ in range(V):
             cap_res = jnp.minimum(
                 M - jnp.sum(swap, axis=0),
-                n_free_local + sent_remote + jnp.sum(res, axis=1),
+                n_free + jnp.sum(res, axis=1),
             ).astype(jnp.int32)
             res = _greedy_alloc(res_eff, jnp.maximum(cap_res, 0)).astype(
                 jnp.int32
             )
-        allowed = swap + res  # [V_src, V_dst]
-        if cycle_rescue and (Dev == 1 or R_total > 128):
-            # drain full-vrank rotation cycles on THIS device (all the
-            # tables are local — no collective needed). A cycle is only
-            # forced if every member stays within the [M] arrival/send
-            # plans (+1 row); partial application would break the
-            # self-financing pairing, so the guard is per whole cycle.
-            # (Above 128 global ranks the global pass below is off —
-            # matching the flat engine's R^2 log R closure bound — and
-            # this per-device rescue is the remaining guarantee.)
-            pending_loc = (res_eff - res).astype(jnp.int32)
-            sends_zero = (
-                jnp.sum(allowed, axis=1) + sent_remote
-            ) == 0
-            ok = (jnp.sum(allowed, axis=1) < M) & (
-                jnp.sum(allowed, axis=0) < M
-            )
-            allowed = allowed + _cycle_rescue(
-                pending_loc, sends_zero, ok
-            )
-        elif cycle_rescue:
-            # GLOBAL rescue (round-3 verdict item 6): a rotation cycle
-            # that SPANS devices has no swap financing in the grant
-            # phase (remote grants draw on free slots only), so at zero
-            # free slots it backlogs under the normal protocol. Gather
-            # the full pending matrix, run the same functional-graph
-            # closure the flat engine uses, and force one row per cycle
-            # edge. The forced arrivals are financed by the forced
-            # departures through the EXISTING landing machinery: a
-            # member's forced remote departure vacates a slot that the
-            # local landing phase pushes onto the free stack
-            # (n_push = n_sent - n_in_local), and the remote landing —
-            # which runs after — pops exactly that slot; local-edge
-            # forced arrivals land in the vacated-slot plan directly.
-            # Every tier stays lossless at zero holes.
-            pending_loc = (res_eff - res).astype(jnp.int32)
-            pending_rows = desired_rem - rem_sent_full  # local cols are 0
-            pending_rows = lax.dynamic_update_slice(
-                pending_rows, pending_loc, (jnp.int32(0), loc0)
-            )  # [V, R_total]
-            sent_loc_v = jnp.sum(allowed, axis=1).astype(jnp.int32)
-            recv_loc_v = jnp.sum(allowed, axis=0).astype(jnp.int32)
+        allowed_s = (swap + res).astype(jnp.int32)
+        n_sent_s = jnp.sum(allowed_s, axis=1).astype(jnp.int32)
+        n_in_s = jnp.sum(allowed_s, axis=0).astype(jnp.int32)
+        # Residence/overflow guard, ONE scalar (a vmapped cond would
+        # lower to a select and run both branches):
+        #   * ok_sel — the mover block holds every leaver, exactly;
+        #   * allowed_s == loc_counts — nothing was clipped by budget,
+        #     free slots, or grants. Since allowed <= eff <= counts
+        #     elementwise, equality means eff == counts too, the dense
+        #     cycle rescue's pending matrix is zero (it would add
+        #     nothing) and backlog is structurally zero;
+        #   * arrivals fit the [B] landing plan.
+        guard = (
+            ok_sel
+            & jnp.all(allowed_s == loc_counts)
+            & jnp.all(n_in_s <= B)
+        )
 
-            def gat(x):
-                return lax.all_gather(x, axes).reshape(
-                    (R_total,) + x.shape[1:]
-                )
+        # gridlint: fastpath-engine
+        def _fast_branch():
+            # O(movers) landing: the mover block IS the vacated-slot
+            # plan (under the guard the dense engine's unclipped vacated
+            # plan is exactly the leaver prefix of sorted order, which
+            # the block reproduces bit-for-bit), arrivals gather B
+            # columns, one targeted scatter writes B columns per vrank,
+            # and the ~98% stayer columns are never touched — no
+            # full-array permutation, no overlay landing.
+            k_b = jnp.arange(B, dtype=jnp.int32)
+            with traced_span("mig:pack"):
+                arr_src, _ = _plan_rows_batched(
+                    loc_starts.T, allowed_s.T, block_rows, B,
+                    seg_rows=jnp.arange(V, dtype=jnp.int32),
+                    row_stride=n,
+                )  # [V_dst, B] global source columns
+                arr_cols = _gather_plan_cols(flat, arr_src)  # [K, V, B]
 
-            pending_g = gat(pending_rows)  # [R_total, R_total]
-            sends_zero_g = gat(sent_loc_v + sent_remote) == 0
-            sent_loc_g = gat(sent_loc_v)
-            recv_loc_g = gat(recv_loc_v)
-            rem_sent_g = gat(rem_sent_full)  # [R_total, R_total]
-            g_all = jnp.arange(R_total, dtype=jnp.int32)
-            succ_g = jnp.argmax(pending_g > 0, axis=1)
-            same_dev = (succ_g // V) == (g_all // V)
-            # per-member guard on ITS forced edge (v -> succ(v)); every
-            # cycle edge is thus checked via its sender. Local edge:
-            # sender's local-send plan AND receiver's [M] arrival plan
-            # have room. Remote edge: the (v, succ) pair buffer has a
-            # free slot (covers both ends; the arrival pops the slot the
-            # departure pushes).
-            ok_g = jnp.where(
-                same_dev,
-                (sent_loc_g < M) & (recv_loc_g[succ_g] < M),
-                rem_sent_g[g_all, succ_g] < C,
-            )
-            F = _cycle_rescue(pending_g, sends_zero_g, ok_g)
-            F_rows = lax.dynamic_slice(
-                F, (loc0, jnp.int32(0)), (V, R_total)
-            )  # my vranks' forced sends
-            F_loc = lax.dynamic_slice(F_rows, (jnp.int32(0), loc0), (V, V))
-            allowed = allowed + F_loc
-            is_local_g2 = (g_all >= loc0) & (g_all < loc0 + V)
-            F_rem = jnp.where(is_local_g2[None, :], 0, F_rows)
-            rem_sent_full = rem_sent_full + F_rem
-            sent_remote = jnp.sum(rem_sent_full, axis=1).astype(jnp.int32)
-            F_cols = lax.dynamic_slice(
-                F, (jnp.int32(0), loc0), (R_total, V)
-            )  # forced arrivals into my vranks, by global source
-            F_cols_rem = jnp.where(is_local_g2[:, None], 0, F_cols)
-            recv_counts_rem = recv_counts_rem + F_cols_rem.T
-            n_in_rem = jnp.sum(recv_counts_rem, axis=1).astype(jnp.int32)
-        sent_local = jnp.sum(allowed, axis=1).astype(jnp.int32)
-        n_in_local = jnp.sum(allowed, axis=0).astype(jnp.int32)
-
-        # ---- remote sends: [Dev, V_src, V_dst, K, C] over ICI ---------
-        if Dev > 1:
-            # build the send buffer by index arithmetic + one flat column
-            # gather; global rank ids enumerate dev-major (columns
-            # 0..R_total-1 of the count/bound tables)
-            c_i = jnp.arange(C, dtype=jnp.int32)
-            cnt_sg = rem_sent_full  # [V_src, R_total]
-            start_sg = bounds[:, :R_total]
-            valid = c_i[None, None, :] < cnt_sg[:, :, None]
-            pos = start_sg[:, :, None] + c_i[None, None, :]
-            # flat 1-D take (same ~33 ns/elem batched-gather avoidance
-            # as the plan paths; take_along_axis with 2-D indices falls
-            # back to the slow lowering)
-            row = jnp.take(
-                order.reshape(1, -1),
-                (
-                    my_v[:, None] * n
-                    + jnp.clip(pos, 0, n - 1).reshape(V, -1)
-                ).reshape(-1),
-                axis=1,
-            ).reshape(V, Dev * V, C)
-            gsrc = my_v[:, None, None] * n + row
-            vals = jnp.take(flat, gsrc.reshape(-1), axis=1).reshape(
-                K, V, Dev, V, C
-            )
-            send = jnp.where(
-                valid.reshape(V, Dev, V, C)[None], vals, 0
-            )
-            # [K, V_src, Dev, V_dst, C] -> [Dev, V_src, V_dst, K, C]
-            send = send.transpose(2, 1, 3, 0, 4)
-            with traced_span("mig:exchange"):
-                recv = lax.all_to_all(
-                    send, axes, split_axis=0, concat_axis=0, tiled=True
-                )  # [Dev_src, V_src, V_dst, K, C]
-                # per-dst pools: [V_dst, K, Dev_src * V_src * C]; arrival
-                # counts (recv_counts_rem) were derived locally in the
-                # grant phase — no extra counts exchange needed
-                recv = recv.transpose(2, 3, 0, 1, 4).reshape(
-                    V, K, Dev * V * C
-                )
-
-        n_sent = sent_local + sent_remote
-
-        # ---- vacated slots: all columns leaving each vrank ------------
-        # segments: V local pairs (prefix `allowed`) then, with Dev > 1,
-        # R_total global ranks (remote prefix `rem_sent_full`).
-        if Dev > 1:
-            seg_starts = jnp.concatenate(
-                [loc_starts, bounds[:, :R_total]], axis=1
-            )
-            seg_counts = jnp.concatenate([allowed, rem_sent_full], axis=1)
-            vacated, _tot = _plan_rows_batched(
-                seg_starts, seg_counts, order, P
-            )  # [V, P] (linearized — vmapped gathers cost ~33 ns/elem)
-        elif P <= n:
-            # UNCLIPPED fast path (single-device): stayers sort to the
-            # END (sentinel key R_total), so leavers are a PREFIX of
-            # sorted space grouped by dest, and `eff`'s budget cap is a
-            # prefix truncation — when the grant phase clips nothing
-            # (allowed == eff, the steady-state common case) the slow
-            # plan's positions reduce to pos[v, j] = j exactly, i.e.
-            # vacated IS order[:, :P]. The telescoped-einsum plan + its
-            # ~19 ns/element order[pos] take (round-4 north-star
-            # knockout: +30 ms, the phase-4 floor) collapse to one
-            # slice. Entries beyond sum(allowed) differ between the
-            # branches but are never read (every consumer masks at
-            # k < n_sent). Clipped steps take the exact slow path.
-            if os.environ.get("MPI_GRID_VACATED_PLAN") == "slow":
-                # diagnostic escape hatch (trace-time): force the general
-                # plan to measure what the fast path saves in context
-                vacated = _plan_rows_batched(
-                    loc_starts, allowed, order, P
-                )[0]
-            else:
-                unclipped = jnp.all(allowed == eff)
-                vacated = lax.cond(
-                    unclipped,
-                    lambda: lax.slice_in_dim(order, 0, P, axis=1),
-                    lambda: _plan_rows_batched(
-                        loc_starts, allowed, order, P
-                    )[0],
-                )
-        else:
-            vacated, _tot = _plan_rows_batched(
-                loc_starts, allowed, order, P
-            )
-
-        # ---- local arrivals: one column gather sized to the budget ----
-        # dst w's arrivals: sources in order, first allowed[s, w] rows of
-        # each (s -> w) segment; arrival columns are globally indexed so
-        # one flat gather serves every vrank.
-        # dst w's plan walks SOURCE s's sorted space at segment (s -> w):
-        # same telescoped/flat-take machinery as the vacated plan
-        # (seg_rows maps segment s to order row s and globalizes the
-        # result to s * n + row; the vmapped `order[s, pos]` form this
-        # replaces pays the ~33 ns/element batched-gather toll — the
-        # round-4 knockout hid it inside the in-context landing phase).
-        with traced_span("mig:pack"):
-            arr_src, _ = _plan_rows_batched(
-                loc_starts.T, allowed.T, order, M,
-                seg_rows=jnp.arange(V, dtype=jnp.int32),
-            )  # [V_dst, M] global source columns
-            arr_cols = jnp.take(flat, arr_src.reshape(-1), axis=1).reshape(
-                K, V, M
-            )
-
-        # ---- landing plan: one flat scatter for arrivals + holes ------
-        k_idx = jnp.arange(P, dtype=jnp.int32)
-
-        def land_plan(vac, nin, nsent, nf):
-            n_pop = jnp.clip(nin - nsent, 0, nf)
-            pop_idx = jnp.clip(nf - 1 - (k_idx - nsent), 0, n - 1)
-            target = jnp.where(
-                k_idx < jnp.minimum(nin, nsent),
-                vac,
-                jnp.where(
-                    (k_idx >= nsent) & (k_idx < nsent + n_pop),
-                    jnp.zeros((), jnp.int32),  # replaced below (stack)
+            def land_plan(vac, nin, nsent, nf):
+                n_pop = jnp.clip(nin - nsent, 0, nf)
+                target = jnp.where(
+                    k_b < jnp.minimum(nin, nsent),
+                    vac,
                     jnp.where(
-                        (k_idx >= nin) & (k_idx < nsent), vac, n
+                        (k_b >= nsent) & (k_b < nsent + n_pop),
+                        jnp.zeros((), jnp.int32),  # replaced below
+                        jnp.where(
+                            (k_b >= nin) & (k_b < nsent), vac, n
+                        ),
                     ),
-                ),
-            )
-            return target, n_pop, pop_idx
-
-        targets, n_pop, pop_idx = jax.vmap(land_plan)(
-            vacated, n_in_local, n_sent, n_free
-        )
-        # The pop positions are an AFFINE sequence (stack head downward:
-        # nf-1, nf-2, ... for k in [nsent, nsent+n_pop)), so the gather
-        # is really a reversed contiguous window: slice it, reverse it,
-        # and shift it into k-alignment with one more dynamic slice —
-        # [P]-sized copies instead of a V*P-element random gather.
-        W2 = min(P, n)  # window length (P can exceed n in tiny tests)
-
-        def pops_window(fs_v, nf, nsent):
-            start = jnp.clip(nf - W2, 0, n - W2)
-            win_rev = lax.dynamic_slice(fs_v, (start,), (W2,))[::-1]
-            # win_rev[i] = fs_v[start + W2 - 1 - i]; want
-            # pops[k] = fs_v[nf - 1 - (k - nsent)] = win_rev[k + s],
-            # s = start + W2 - nf - nsent  (every in-use k lands inside
-            # the window; out-of-use entries read the zero pads and are
-            # masked by use_pop below)
-            s = start + W2 - nf - nsent
-            buf = jnp.concatenate(
-                [
-                    jnp.zeros((P,), fs_v.dtype),
-                    win_rev,
-                    jnp.zeros((P,), fs_v.dtype),
-                ]
-            )
-            return lax.dynamic_slice(buf, (s + P,), (P,))
-
-        pops = jax.vmap(pops_window)(free_stack, n_free, n_sent)
-        use_pop = (k_idx[None, :] >= n_sent[:, None]) & (
-            k_idx[None, :] < (n_sent + n_pop)[:, None]
-        )
-        targets = jnp.where(use_pop, pops, targets)
-        # global column ids; sentinel n -> out of range of [V*n] (dropped)
-        gtargets = jnp.where(
-            targets >= n, V * n, my_v[:, None] * n + targets
-        )
-        cols_w = jnp.zeros((K, V, P), flat.dtype).at[:, :, :M].set(
-            arr_cols
-        )
-        cols_w = jnp.where(
-            (k_idx[None, :] < n_in_local[:, None])[None], cols_w, 0
-        )
-        with traced_span("mig:unpack"):
-            flat = _land_scatter(
-                flat, gtargets.reshape(-1), cols_w.reshape(K, V * P),
-                scatter_impl,
-            )
-
-        # ---- free-stack update (contiguous window blend) --------------
-        n_push = jnp.maximum(n_sent - n_in_local, 0)
-        free_stack, n_free = jax.vmap(_stack_push_pop)(
-            free_stack, n_free, n_pop, n_push, vacated, n_in_local
-        )
-
-        # ---- remote landing: pops only, overflow counted --------------
-        if Dev > 1:
-            P_rem = Dev * V * C
-            kr = jnp.arange(P_rem, dtype=jnp.int32)
-
-            def land_remote(f, fs, nf, pool, rcnt):
-                # f [K, n] (one vrank's columns), pool [K, P_rem]
-                cum = jnp.concatenate(
-                    [jnp.zeros((1,), jnp.int32), jnp.cumsum(rcnt)]
-                ).astype(jnp.int32)
-                nin = cum[-1]
-                # cum here has Dev*V + 1 entries (scales with the whole
-                # machine): use the auto helper (merge-sort searchsorted
-                # beyond O(tens) segments)
-                s = jnp.clip(
-                    _segment_of_auto(kr, cum), 0, Dev * V - 1
                 )
-                src_slot = jnp.clip(
-                    s * C + (kr - cum[s]), 0, P_rem - 1
-                )
-                arrivals = jnp.take(pool, src_slot, axis=1)
-                npop = jnp.minimum(nin, nf)
-                dropped = (nin - npop).astype(jnp.int32)
-                pop_i = jnp.clip(nf - 1 - kr, 0, n - 1)
-                tgt = jnp.where(kr < npop, fs[pop_i], n)
-                f = f.at[:, tgt].set(
-                    jnp.where((kr < nin)[None, :], arrivals, 0),
-                    mode="drop",
-                )
-                return f, nf - npop, nin, dropped
+                return target, n_pop
 
-            flat3, n_free, n_in_rem, dropped_recv = jax.vmap(
-                land_remote,
-                in_axes=(1, 0, 0, 0, 0),
-                out_axes=(1, 0, 0, 0),
-            )(flat.reshape(K, V, n), free_stack, n_free, recv,
-              recv_counts_rem)
-            flat = flat3.reshape(K, V * n)
-            received = n_in_local + n_in_rem
-        else:
-            dropped_recv = jnp.zeros((V,), jnp.int32)
-            received = n_in_local
+            targets, n_pop = jax.vmap(land_plan)(
+                block_rows, n_in_s, n_sent_s, n_free
+            )
+            Wb = min(B, n)
 
-        backlog = (leavers - n_sent).astype(jnp.int32)
-        population = jnp.sum(
-            (flat[-1, :].reshape(V, n) > 0).astype(jnp.int32), axis=1
+            def pops_window(fs_v, nf, nsent):
+                start = jnp.clip(nf - Wb, 0, n - Wb)
+                win_rev = lax.dynamic_slice(fs_v, (start,), (Wb,))[::-1]
+                s = start + Wb - nf - nsent
+                buf = jnp.concatenate(
+                    [
+                        jnp.zeros((B,), fs_v.dtype),
+                        win_rev,
+                        jnp.zeros((B,), fs_v.dtype),
+                    ]
+                )
+                return lax.dynamic_slice(buf, (s + B,), (B,))
+
+            pops = jax.vmap(pops_window)(free_stack, n_free, n_sent_s)
+            use_pop = (k_b[None, :] >= n_sent_s[:, None]) & (
+                k_b[None, :] < (n_sent_s + n_pop)[:, None]
+            )
+            targets = jnp.where(use_pop, pops, targets)
+            gtargets = jnp.where(
+                targets >= n, V * n, my_v[:, None] * n + targets
+            )
+            cols_w = jnp.where(
+                (k_b[None, :] < n_in_s[:, None])[None], arr_cols, 0
+            )
+            with traced_span("mig:unpack"):
+                # always the targeted XLA scatter: the overlay kernel's
+                # one-hot matmul is O(n * plan) — exactly the
+                # O(residents) landing cost this branch exists to avoid
+                new_flat = _land_scatter(
+                    flat, gtargets.reshape(-1),
+                    cols_w.reshape(K, V * B), "xla",
+                )
+            n_push = jnp.maximum(n_sent_s - n_in_s, 0)
+            new_stack, new_free = jax.vmap(_stack_push_pop)(
+                free_stack, n_free, n_pop, n_push, block_rows, n_in_s
+            )
+            stats = MigrateStats(
+                sent=n_sent_s,
+                received=n_in_s,
+                # stack invariant: population == n - n_free (init_state
+                # builds the stack from the alive row; every landing
+                # preserves it) — an O(V) read where the dense engine
+                # pays an O(n) alive-row reduce
+                population=(n - new_free).astype(jnp.int32),
+                backlog=jnp.zeros((V,), jnp.int32),
+                dropped_recv=jnp.zeros((V,), jnp.int32),
+                flow=allowed_s,
+            )
+            return MigrateState(new_flat, new_stack, new_free), stats
+
+        # the dense fallback goes through a lambda, not a bare function
+        # reference: _step's Dev > 1 collectives are statically absent
+        # here (Dev == 1), and the lambda keeps gridlint's G001
+        # cond-branch scan (lexical by design) out of the dense body
+        out_state, stats = lax.cond(
+            guard,
+            _fast_branch,
+            lambda: _step(flat, free_stack, n_free, dest_key),
         )
-        # my V rows of the global [R_total, R_total] flow matrix: remote
-        # granted sends with the local block overlaid (both tables are
-        # already live for the pack phase — pure stacking, no collective,
-        # no host sync). With Dev == 1 the local table IS the full matrix.
-        if Dev > 1:
-            flow_rows = lax.dynamic_update_slice(
-                rem_sent_full, allowed, (jnp.int32(0), loc0)
-            )  # [V, R_total]
-        else:
-            flow_rows = allowed
-        stats = MigrateStats(
-            sent=n_sent,
-            received=received,
-            population=population,
-            backlog=backlog,
-            dropped_recv=dropped_recv,
-            flow=flow_rows,
+        return out_state, stats._replace(
+            fast_path=jnp.broadcast_to(guard.astype(jnp.int32), (V,))
         )
-        return MigrateState(flat, free_stack, n_free), stats
 
     return fn
 

@@ -44,6 +44,8 @@ Event schema: ``telemetry/SCHEMA.md``.
 from mpi_grid_redistribute_tpu.telemetry.recorder import (  # noqa: F401
     Event,
     StepRecorder,
+    fast_path_hit_rate,
+    record_fast_path_steps,
     record_migrate_steps,
 )
 from mpi_grid_redistribute_tpu.telemetry.phases import (  # noqa: F401
@@ -73,6 +75,7 @@ from mpi_grid_redistribute_tpu.telemetry.health import (  # noqa: F401
     HealthMonitor,
     HealthRule,
     default_rules,
+    fast_path_fallback,
 )
 from mpi_grid_redistribute_tpu.telemetry.traceview import (  # noqa: F401
     to_chrome_trace,

@@ -30,6 +30,10 @@ The stock rules (:func:`default_rules`):
 * ``step_time_spike`` — the latest ``step_time`` event above ``factor``
   x the EMA of the preceding ones (feed :meth:`HealthMonitor.note_step_time`
   from the driver's timing loop). WARN.
+* ``fast_path_fallback`` — the sparse migrate engine fell back to the
+  dense planar path on more than ``threshold`` of the last ``window``
+  ``fast_path`` events: ``mover_cap`` is undersized (or the workload is
+  not mover-sparse) and every step pays guard + dense cost. WARN.
 """
 
 from __future__ import annotations
@@ -160,6 +164,35 @@ def step_time_spike(factor: float = 3.0, min_samples: int = 4) -> HealthRule:
     return HealthRule("step_time_spike", WARN, fn)
 
 
+def fast_path_fallback(
+    window: int = 16, threshold: float = 0.5
+) -> HealthRule:
+    """WARN when more than ``threshold`` of the last ``window``
+    ``fast_path`` events took the dense fallback — the sparse engine is
+    compiled in but mostly not running (undersized ``mover_cap`` or a
+    workload that is not mover-sparse), so steps pay the routing guard
+    on top of the full dense cost. Needs a full window of events before
+    it can fire (a cold journal is not evidence)."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        ev = rec.events("fast_path")[-window:]
+        if len(ev) < window:
+            return None
+        fallbacks = sum(1 - int(e.data.get("taken", 0)) for e in ev)
+        rate = fallbacks / len(ev)
+        if rate > threshold:
+            return (
+                f"sparse fast path fell back on {fallbacks}/{len(ev)} of "
+                f"the last steps ({rate:.0%} > {threshold:.0%}): grow "
+                f"mover_cap or run engine='planar'"
+            )
+        return None
+
+    return HealthRule("fast_path_fallback", WARN, fn)
+
+
 def default_rules() -> List[HealthRule]:
     return [
         backlog_growth(),
@@ -167,6 +200,7 @@ def default_rules() -> List[HealthRule]:
         capacity_grow_frequency(),
         imbalance_ratio(),
         step_time_spike(),
+        fast_path_fallback(),
     ]
 
 

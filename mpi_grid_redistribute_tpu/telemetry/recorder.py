@@ -19,6 +19,11 @@ Event kinds emitted by the in-repo instruments:
   §5.3: surfaced, never silent).
 * ``migrate_step`` — per-step send/recv/backlog counters from a
   step-stacked ``MigrateStats`` (:func:`record_migrate_steps`).
+* ``fast_path`` — per-step sparse-engine routing outcome (taken vs
+  dense fallback, mover count vs ``mover_cap``) from
+  :func:`record_fast_path_steps` (ISSUE 4).
+* ``mover_cap_grow`` — :class:`..api.MoverCapacity` ratcheted the
+  sparse engine's mover block (old/new cap, measured peak).
 """
 
 from __future__ import annotations
@@ -197,3 +202,58 @@ def record_migrate_steps(
             **extra,
         )
     return sent.shape[0] - start
+
+
+def record_fast_path_steps(
+    recorder: StepRecorder,
+    stats,
+    mover_cap: Optional[int] = None,
+    max_steps: Optional[int] = None,
+) -> int:
+    """Feed a step-stacked ``MigrateStats`` from a sparse-capable engine
+    into ``recorder`` as one ``fast_path`` event per step: whether the
+    mover-sparse branch ran (``taken``) or the step fell back to the
+    dense planar engine, plus the exact mover count that drove the
+    routing guard (``movers = sent + backlog`` — granted sends plus
+    held-back leavers) and, when given, the static ``mover_cap`` the
+    count was checked against. Same host-transfer contract as
+    :func:`record_migrate_steps`: call it where the driver already reads
+    stats. ``max_steps`` keeps only the trailing window. Returns events
+    recorded.
+
+    Raises a named ValueError when ``stats.fast_path`` is None — that
+    means the loop was built without ``mover_cap`` and carries no sparse
+    path, so journaling a 0% hit rate for it would misread as "always
+    falling back"."""
+    if stats.fast_path is None:
+        raise ValueError(
+            "MigrateStats.fast_path is None: this loop was built without"
+            " mover_cap (no sparse path to journal); build it with"
+            " engine='auto'/'sparse' on a sparse-eligible config first"
+        )
+    fp = np.asarray(stats.fast_path)
+    fp = fp.reshape(-1, fp.shape[-1])
+    sent = np.asarray(stats.sent).reshape(fp.shape)
+    backlog = np.asarray(stats.backlog).reshape(fp.shape)
+    start = 0 if max_steps is None else max(0, fp.shape[0] - max_steps)
+    extra = {} if mover_cap is None else {"mover_cap": int(mover_cap)}
+    for s in range(start, fp.shape[0]):
+        recorder.record(
+            "fast_path",
+            step=s,
+            # the guard is one scalar broadcast across ranks: any() == all()
+            taken=int(bool(fp[s].any())),
+            movers=int((sent[s] + backlog[s]).sum()),
+            movers_max_rank=int((sent[s] + backlog[s]).max()),
+            **extra,
+        )
+    return fp.shape[0] - start
+
+
+def fast_path_hit_rate(recorder: StepRecorder) -> Optional[float]:
+    """Fraction of retained ``fast_path`` events with ``taken=1``; None
+    when no sparse-engine steps have been journaled."""
+    ev = recorder.events("fast_path")
+    if not ev:
+        return None
+    return sum(int(e.data.get("taken", 0)) for e in ev) / len(ev)

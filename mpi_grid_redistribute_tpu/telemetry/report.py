@@ -123,6 +123,15 @@ def exchange_report(
         out["links"] = flow_lib.link_report(
             mean_matrix, row_bytes, step_seconds=step_seconds, domain=domain
         )
+    # sparse fast-path hit rate (ISSUE 4): present whenever the stats
+    # came from a sparse-capable loop (fast_path leaf is a [S, R] 1/0
+    # guard trace; dense-only loops carry None and omit the field).
+    fp = getattr(stats, "fast_path", None)
+    if fp is not None:
+        fp = np.asarray(fp).reshape(-1, np.asarray(fp).shape[-1])
+        taken = int(np.count_nonzero(fp.any(axis=1)))
+        out["fast_path_steps"] = taken
+        out["fast_path_hit_rate"] = taken / fp.shape[0] if fp.shape[0] else None
     if recorder is not None:
         out["events"] = recorder.counts()
         out["events_evicted"] = recorder.evicted

@@ -372,6 +372,77 @@ def test_g005_quiet_on_bounded_partial_wrapped_kernel(tmp_path):
     assert findings == [], findings
 
 
+# ---------------------------------------------------------------- G006
+
+
+def test_g006_fires_on_sort_and_arange_take_in_marked_fn(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax.numpy as jnp
+    from jax import lax
+
+    # gridlint: fastpath-engine
+    def fast_branch(flat, block, n):
+        order = lax.sort(block, dimension=-1)
+        cols = jnp.take(flat, jnp.arange(n), axis=1)
+        return order, cols
+    """,
+        },
+        rules=["G006"],
+    )
+    assert rules_of(findings) == ["G006"], findings
+    assert len(findings) == 2
+    assert any("sort" in f.message for f in findings)
+    assert any("arange/iota" in f.message for f in findings)
+
+
+def test_g006_quiet_on_plan_indexed_gather_and_unmarked_fn(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax.numpy as jnp
+    from jax import lax
+
+    # gridlint: fastpath-engine
+    def fast_branch(flat, plan, window):
+        # plan-shaped gather: indices come in as a value, no iota
+        cols = jnp.take(flat, plan.reshape(-1), axis=1)
+        win = lax.dynamic_slice(window, (0,), (8,))
+        return cols, win
+
+    def dense_engine(dest, n):
+        # unmarked: the dense engine may sort residents freely
+        order = jnp.argsort(dest)
+        return jnp.take(dest, jnp.arange(n))
+    """,
+        },
+        rules=["G006"],
+    )
+    assert findings == [], findings
+
+
+def test_g006_sees_nested_defs_in_marked_fn(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax.numpy as jnp
+
+    # gridlint: fastpath-engine
+    def fast_branch(block):
+        def inner(row):
+            return jnp.sort(row)
+        return inner(block)
+    """,
+        },
+        rules=["G006"],
+    )
+    assert rules_of(findings) == ["G006"], findings
+
+
 # ------------------------------------------------- suppressions, baseline
 
 

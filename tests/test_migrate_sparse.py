@@ -1,0 +1,389 @@
+"""Mover-sparse migrate fast path (ISSUE 4): bit-identity vs the planar
+engine, routing guard behavior, jaxpr cost contract, telemetry.
+
+The sparse engine is an *engine*, not a semantic: under the residence
+guard it must reproduce the planar engine's output bit-for-bit (row sets
+AND slot order AND stats counters — same grants, same vacated slots,
+same stack), fall back to the dense step when the guard trips, and its
+cond fast branch must contain no resident-scale op (no sort, no full-
+array gather) — asserted structurally on the jaxpr, since a silent cost
+regression would pass every correctness test.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_grid_redistribute_tpu import api
+from mpi_grid_redistribute_tpu import telemetry
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.ops import binning
+from mpi_grid_redistribute_tpu.parallel import exchange
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+MESHES = [
+    ((1, 1, 1), (2, 2, 2)),
+    ((2, 2, 1), (1, 2, 2)),
+    ((2, 1, 1), (2, 2, 1)),
+]
+
+
+def _drift_inputs(dev_shape, v_shape, n_local, rng, hole_frac=0.125):
+    """Legal start state: live rows on the slab owning their position."""
+    dev_grid = ProcessGrid(dev_shape)
+    vgrid = ProcessGrid(v_shape)
+    full = ProcessGrid(
+        tuple(d * v for d, v in zip(dev_shape, v_shape))
+    )
+    n = full.nranks * n_local
+    pos = rng.random((n, 3), dtype=np.float32)
+    vel = (0.6 * (rng.random((n, 3), dtype=np.float32) - 0.5)).astype(
+        np.float32
+    )
+    alive = rng.random(n) > hole_frac
+    domain = Domain(0.0, 1.0, periodic=True)
+    dest = binning.rank_of_position(pos, domain, full, xp=np)
+    # device-major slab rank per slot (same construction as test_migrate)
+    slab = []
+    for d in range(dev_grid.nranks):
+        dc = dev_grid.cell_of_rank(d)
+        for v in range(vgrid.nranks):
+            vc = vgrid.cell_of_rank(v)
+            cell = tuple(
+                dc[a] * v_shape[a] + vc[a] for a in range(len(dc))
+            )
+            slab.append(full.rank_of_cell(cell))
+    slot_slab = np.repeat(np.asarray(slab), n_local)
+    alive &= dest == slot_slab
+    return domain, dev_grid, vgrid, pos, vel, alive
+
+
+def _run(domain, dev_grid, vgrid, pos, vel, alive, *, engine,
+         mover_cap=None, n_local, steps=5, dt=0.07):
+    mesh = mesh_lib.make_mesh(dev_grid)
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=dt, capacity=n_local,
+        n_local=n_local, engine=engine, mover_cap=mover_cap,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, steps, vgrid=vgrid)
+    return jax.tree.map(np.asarray, loop(pos, vel, alive))
+
+
+def _assert_bitexact(a, b):
+    """pos/vel/alive/stats tuples equal to the BIT, slot order included."""
+    pa, va, aa, sa = a
+    pb, vb, ab, sb = b
+    assert np.array_equal(pa.view(np.uint32), pb.view(np.uint32))
+    assert np.array_equal(va.view(np.uint32), vb.view(np.uint32))
+    assert np.array_equal(aa, ab)
+    for name in ("sent", "received", "population", "backlog",
+                 "dropped_recv", "flow"):
+        assert np.array_equal(
+            np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        ), name
+
+
+@pytest.mark.parametrize("dev_shape,v_shape", MESHES)
+def test_sparse_matches_planar_bitexact(dev_shape, v_shape, rng, _devices):
+    n_local = 64
+    domain, dev_grid, vgrid, pos, vel, alive = _drift_inputs(
+        dev_shape, v_shape, n_local, rng
+    )
+    ref = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="planar", n_local=n_local)
+    got = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="auto", n_local=n_local)
+    _assert_bitexact(ref, got)
+    assert ref[3].fast_path is None  # planar build carries no sparse path
+    if dev_grid.nranks == 1:
+        # single-device vranks: auto routes sparse, leaf is [S, V]
+        fp = np.asarray(got[3].fast_path)
+        assert fp.shape == (5, vgrid.nranks)
+    else:
+        # multi-device: auto resolves to planar, no sparse path at all
+        assert got[3].fast_path is None
+
+
+def test_sparse_zero_movers_takes_fast_path_every_step(rng, _devices):
+    n_local = 64
+    domain, dev_grid, vgrid, pos, vel, alive = _drift_inputs(
+        (1, 1, 1), (2, 2, 2), n_local, rng
+    )
+    # dt=0: nothing ever leaves its slab — the degenerate sparse case
+    ref = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="planar", n_local=n_local, dt=0.0)
+    got = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="sparse", n_local=n_local, dt=0.0)
+    _assert_bitexact(ref, got)
+    assert np.asarray(got[3].sent).sum() == 0
+    assert np.asarray(got[3].fast_path).all()
+
+
+def test_sparse_full_swap_falls_back_bitexact(rng, _devices):
+    """config7-stress shape: ~100% movers per step. The per-chunk
+    candidate cap structurally cannot hold that, so every step must take
+    the dense fallback — and stay bit-identical doing it."""
+    n_local = 64
+    dev_grid = ProcessGrid((1, 1, 1))
+    vgrid = ProcessGrid((2, 1, 1))
+    n = 2 * n_local
+    domain = Domain(0.0, 1.0, periodic=True)
+    pos = rng.random((n, 3), dtype=np.float32)
+    pos[:n_local, 0] = 0.75  # vrank 0's rows all in vrank 1's half
+    pos[n_local:, 0] = 0.25
+    vel = np.zeros((n, 3), dtype=np.float32)
+    alive = np.ones(n, dtype=bool)
+    ref = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="planar", n_local=n_local, steps=1, dt=0.0)
+    got = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="sparse", mover_cap=8, n_local=n_local,
+               steps=1, dt=0.0)
+    _assert_bitexact(ref, got)
+    assert np.asarray(got[3].sent).sum() == n  # everyone still moved
+    assert not np.asarray(got[3].fast_path).any()
+
+
+def test_static_infeasibility_runs_dense_with_zero_leaf(
+    rng, _devices, monkeypatch
+):
+    """MPI_GRID_SELECT=flat disables the two-level selection the sparse
+    engine is built from: the build must quietly run dense and keep the
+    stats pytree uniform (fast_path present, all zeros) so stacked loops
+    don't change structure with the env."""
+    monkeypatch.setenv("MPI_GRID_SELECT", "flat")
+    n_local = 64
+    domain, dev_grid, vgrid, pos, vel, alive = _drift_inputs(
+        (1, 1, 1), (2, 2, 2), n_local, rng
+    )
+    got = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="sparse", n_local=n_local)
+    fp = np.asarray(got[3].fast_path)
+    assert fp.shape == (5, vgrid.nranks)
+    assert not fp.any()
+
+
+def test_mover_capacity_growth_recovers_fast_path(rng, _devices):
+    """Measured-need growth: an undersized mover_cap falls back (never
+    errors), MoverCapacity folds the observed peak and ratchets, and the
+    rebuilt loop routes sparse again — the same grow-on-measurement
+    lifecycle the canonical engine runs on capacity."""
+    n_local = 64
+    dev_grid = ProcessGrid((1, 1, 1))
+    vgrid = ProcessGrid((2, 1, 1))
+    n = 2 * n_local
+    domain = Domain(0.0, 1.0, periodic=True)
+    pos = rng.random((n, 3), dtype=np.float32)
+    pos[:, 0] = pos[:, 0] * 0.5 + 0.5 * (np.arange(n) >= n_local)
+    # exactly 6 movers: six vrank-0 rows sitting in vrank 1's half
+    pos[:6, 0] = 0.75
+    vel = np.zeros((n, 3), dtype=np.float32)
+    alive = np.ones(n, dtype=bool)
+    alive[n_local : n_local + 16] = False  # room to receive
+
+    rec = telemetry.StepRecorder()
+    mc = api.MoverCapacity(1, recorder=rec)
+    out = _run(domain, dev_grid, vgrid, pos, vel, alive,
+               engine="sparse", mover_cap=mc.value, n_local=n_local,
+               steps=1, dt=0.0)
+    assert not np.asarray(out[3].fast_path).any()  # undersized: fallback
+    assert np.asarray(out[3].sent).sum() == 6  # dense still moved them
+    grew = mc.update(out[3])
+    assert grew and mc.value == 8  # next pow2 over the measured peak
+    assert rec.counts().get("mover_cap_grow") == 1
+
+    out2 = _run(domain, dev_grid, vgrid, pos, vel, alive,
+                engine="sparse", mover_cap=mc.value, n_local=n_local,
+                steps=1, dt=0.0)
+    assert np.asarray(out2[3].fast_path).all()
+    assert np.asarray(out2[3].sent).sum() == 6
+    assert not mc.update(out2[3])  # converged: never shrinks, no thrash
+    assert mc.value == 8
+
+
+# ------------------------------------------------- jaxpr cost contract
+
+
+def _walk_eqns(jaxpr):
+    """Every eqn in ``jaxpr`` and its nested jaxprs (pjit/scan/cond/
+    shard_map bodies alike), depth-first."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn):
+            yield from _walk_eqns(sub)
+
+
+def _subjaxprs(eqn):
+    for v in eqn.params.values():
+        for j in _as_jaxprs(v):
+            yield j
+
+
+def _as_jaxprs(v):
+    if hasattr(v, "eqns"):
+        return [v]
+    if hasattr(v, "jaxpr"):
+        return [v.jaxpr]
+    if isinstance(v, (tuple, list)):
+        out = []
+        for x in v:
+            out.extend(_as_jaxprs(x))
+        return out
+    return []
+
+
+def _has_sort(jaxpr):
+    return any(e.primitive.name == "sort" for e in _walk_eqns(jaxpr))
+
+
+def test_fast_branch_jaxpr_has_no_resident_scale_ops(rng, _devices):
+    n_local = 64
+    domain, dev_grid, vgrid, pos, vel, alive = _drift_inputs(
+        (1, 1, 1), (2, 2, 2), n_local, rng
+    )
+    mesh = mesh_lib.make_mesh(dev_grid)
+    mover_cap = 16
+    cfg = nbody.DriftConfig(
+        domain=domain, grid=dev_grid, dt=0.07, capacity=n_local,
+        n_local=n_local, engine="sparse", mover_cap=mover_cap,
+    )
+    loop = nbody.make_migrate_loop(cfg, mesh, 3, vgrid=vgrid)
+    # trace with planar-flat (1-D) payloads: the loop host-packs numpy
+    # rows but passes device/tracer arrays through untouched
+    pos_p = nbody.rows_to_planar(pos, mesh.size)
+    vel_p = nbody.rows_to_planar(vel, mesh.size)
+    jaxpr = jax.make_jaxpr(loop)(pos_p, vel_p, alive).jaxpr
+
+    # no host round-trips anywhere in the compiled step
+    assert not any(
+        "callback" in e.primitive.name for e in _walk_eqns(jaxpr)
+    )
+
+    # the engine-dispatch cond is the one whose branches DISAGREE about
+    # sorting: dense sorts residents, the fast branch must not sort at
+    # all (the selection sorts live outside the cond, in the shared
+    # prefix). Inner conds — two_level's flat fallback, the vacated-plan
+    # guard — sort on both sides or on neither.
+    dispatch = []
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = list(eqn.params["branches"])
+        sorted_flags = [_has_sort(b.jaxpr) for b in branches]
+        if len(set(sorted_flags)) == 2:
+            fast = branches[sorted_flags.index(False)].jaxpr
+            dispatch.append((eqn, fast))
+    assert dispatch, "engine-dispatch cond not found in jaxpr"
+
+    resident_elems = pos.shape[0]  # V * n rows
+    for _, fast in dispatch:
+        for e in _walk_eqns(fast):
+            assert e.primitive.name != "sort"
+            if e.primitive.name == "gather":
+                # every gather in the fast branch reads a mover-scale
+                # block, never a resident-scale permutation
+                out_rows = max(
+                    int(np.prod(v.aval.shape[1:])) if v.aval.shape else 1
+                    for v in e.outvars
+                )
+                assert out_rows < resident_elems, (
+                    f"fast-branch gather produces {out_rows} rows "
+                    f">= resident count {resident_elems}"
+                )
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def _sparse_stats(rng, _devices, steps=5):
+    n_local = 64
+    domain, dev_grid, vgrid, pos, vel, alive = _drift_inputs(
+        (1, 1, 1), (2, 2, 2), n_local, rng
+    )
+    return _run(domain, dev_grid, vgrid, pos, vel, alive,
+                engine="auto", n_local=n_local, steps=steps)[3]
+
+
+def test_record_fast_path_and_report_hit_rate(rng, _devices):
+    stats = _sparse_stats(rng, _devices)
+    rec = telemetry.StepRecorder()
+    n_ev = telemetry.record_fast_path_steps(rec, stats, mover_cap=1024)
+    assert n_ev == 5 and rec.counts()["fast_path"] == 5
+    ev = rec.events("fast_path")
+    assert all(e.data["mover_cap"] == 1024 for e in ev)
+    assert all(e.data["movers"] >= e.data["movers_max_rank"] for e in ev)
+    hit = telemetry.fast_path_hit_rate(rec)
+    assert hit == 1.0  # the drift workload is mover-sparse by design
+
+    rep = telemetry.exchange_report(stats, 28)
+    assert rep["fast_path_steps"] == 5
+    assert rep["fast_path_hit_rate"] == 1.0
+
+    # dense-only stats: no hit-rate key in the report, loud error from
+    # the journal bridge (a silent 0% would misread as always-fallback)
+    dense = stats._replace(fast_path=None)
+    assert "fast_path_hit_rate" not in telemetry.exchange_report(dense, 28)
+    with pytest.raises(ValueError, match="fast_path is None"):
+        telemetry.record_fast_path_steps(rec, dense)
+
+
+def test_fast_path_fallback_health_rule(rng, _devices):
+    rec = telemetry.StepRecorder()
+    mon = telemetry.HealthMonitor(rec)
+    rule_names = {r.name for r in mon.rules}
+    assert "fast_path_fallback" in rule_names  # stock rule set
+
+    # under a full window: silent (a cold journal is not evidence)
+    for s in range(8):
+        rec.record("fast_path", step=s, taken=0, movers=50)
+    assert mon.evaluate()["status"] == telemetry.health.OK
+
+    for s in range(8, 16):
+        rec.record("fast_path", step=s, taken=0, movers=50)
+    verdict = mon.evaluate()
+    assert verdict["status"] == "WARN"
+    assert any(
+        f["rule"] == "fast_path_fallback" for f in verdict["findings"]
+    )
+
+    # mostly-taken window: healthy
+    rec2 = telemetry.StepRecorder()
+    for s in range(16):
+        rec2.record("fast_path", step=s, taken=int(s % 8 != 0), movers=3)
+    assert telemetry.HealthMonitor(rec2).evaluate()["status"] == "OK"
+
+
+# ------------------------------------------------------ engine dispatch
+
+
+def test_resolve_engine_matrix():
+    r = exchange.resolve_engine
+    # migrate-loop (non-canonical) routing
+    assert r("auto", vranks=True, n_devices=1) == "sparse"
+    assert r("sparse", vranks=True, n_devices=1) == "sparse"
+    assert r("auto", vranks=True, n_devices=8) == "planar"
+    assert r("auto", vranks=False, n_devices=1) == "planar"
+    assert r("planar", vranks=True, n_devices=1) == "planar"
+    with pytest.raises(ValueError, match="canonical-exchange"):
+        r("rowmajor", vranks=True, n_devices=1)
+    # canonical-exchange routing: sparse degrades to planar (MPI receive
+    # order forces a full repack anyway), rowmajor is the escape hatch
+    assert r("sparse", canonical=True) == "planar"
+    assert r("auto", canonical=True, planar_ok=True) == "planar"
+    assert r("auto", canonical=True, planar_ok=False) == "rowmajor"
+    assert r("rowmajor", canonical=True) == "rowmajor"
+    with pytest.raises(ValueError, match="engine must be one of"):
+        r("warp", vranks=True, n_devices=1)
+
+
+def test_mover_capacity_validation_and_clamp():
+    with pytest.raises(ValueError, match=">= 1"):
+        api.MoverCapacity(0)
+    mc = api.MoverCapacity(5, max_cap=16)
+    assert mc.value == 8  # pow2 bucketing, same as Redistributer
+    stats = type("S", (), {})()
+    stats.sent = np.asarray([100, 0])
+    stats.backlog = np.asarray([3, 0])
+    assert mc.update(stats) and mc.value == 16  # clamped at max_cap
+    assert not mc.update(stats)  # at the clamp: no further growth
