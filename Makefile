@@ -1,15 +1,25 @@
 PY ?= python
 
-.PHONY: test lint lint-json baseline bench-check observe
+.PHONY: test lint lint-json baseline bench-check observe serve-metrics
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 # regression guard: newest BENCH_r*.json capture vs the BEST committed
-# history per guarded metric (value, ms_per_step, exchange_bytes_per_sec);
-# >10% worse on any = exit 1. See mpi_grid_redistribute_tpu/telemetry/regress.py.
+# history per guarded metric. Deltas are classified against the
+# captures' own min-of-k spreads: WOBBLE (within noise) and WARN pass,
+# REGRESSION (beyond max(10%, 2x noise)) = exit 1. `--legacy` restores
+# the plain >10% binary gate. See telemetry/regress.py.
 bench-check:
 	$(PY) scripts/bench_check.py
+
+# metrics plane demo: serve /metrics (OpenMetrics) + /healthz for a
+# small in-process drift loop on 127.0.0.1:9100. Scrape with
+#   curl localhost:9100/metrics
+# Point --journal at StepRecorder JSONL shards to serve a real run
+# (repeat the flag to pod-merge shards). See telemetry/metrics.py.
+serve-metrics:
+	JAX_PLATFORMS=cpu $(PY) scripts/metrics_serve.py --demo --port 9100
 
 # grid observatory smoke: drift demo with the health monitor on, both
 # legs on 8 virtual CPU devices. Balanced leg must stay OK (unexpected
@@ -23,7 +33,7 @@ observe:
 		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
 		--bias --expect-alert
 
-# gridlint: AST-based SPMD/JIT invariant checker (G001-G005).
+# gridlint: AST-based SPMD/JIT invariant checker (G001-G007).
 # Exit 0 = clean or fully baselined; 1 = new findings or stale baseline
 # entries; 2 = usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:

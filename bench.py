@@ -149,6 +149,21 @@ def time_device_pipeline(n_local: int, migration: float, s1: int, s2: int):
     )
     if dropped:
         _stderr("  WARNING: arrivals dropped — raise slab headroom")
+    # BENCH_JOURNAL_DIR=dir: journal the already-fetched stats and write
+    # this process's shard for pod-wide aggregation (ISSUE 5) — zero
+    # extra device reads, stats/per_step are host values at this point
+    if os.environ.get("BENCH_JOURNAL_DIR"):
+        from mpi_grid_redistribute_tpu import telemetry
+
+        rec = telemetry.StepRecorder()
+        telemetry.record_migrate_steps(rec, stats, rank_totals=True)
+        if stats.fast_path is not None:
+            telemetry.record_fast_path_steps(rec, stats)
+        acc = telemetry.FlowAccumulator()
+        acc.update(stats)
+        telemetry.record_flow_snapshot(rec, acc)
+        telemetry.HealthMonitor(rec).note_step_time(per_step)
+        bcommon.write_journal_shard(rec, "bench_headline")
     return total / per_step, n_chips, xbytes, xdomain, per_step, detail
 
 
@@ -196,6 +211,7 @@ def time_cpu_oracle(n_total: int, migration: float, n_steps: int = 5,
 def main() -> None:
     import jax
 
+    from mpi_grid_redistribute_tpu.telemetry import regress
     from mpi_grid_redistribute_tpu.utils import profiling
 
     on_tpu = jax.devices()[0].platform not in ("cpu",)
@@ -281,6 +297,10 @@ def main() -> None:
                     6,
                 ),
                 "stress": stress,
+                # environment fingerprint (telemetry.regress): the
+                # classifier flags cross-capture deltas whose machine
+                # changed out from under them
+                "env": regress.env_fingerprint(),
             }
         )
     )

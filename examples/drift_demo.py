@@ -49,6 +49,10 @@ def main() -> None:
     ap.add_argument("--expect-alert", action="store_true",
                     help="exit non-zero unless the monitor ALERTs (pair "
                          "with --bias; `make observe` uses both modes)")
+    ap.add_argument("--halo", action="store_true",
+                    help="after the drift loop, run one ghost/overlap "
+                         "exchange (rd.halo()) on the redistributed "
+                         "state and print per-rank ghost counts")
     args = ap.parse_args()
 
     import jax
@@ -181,6 +185,23 @@ def main() -> None:
     if not args.expect_alert and verdict["status"] == "ALERT":
         print("unexpected ALERT on a balanced workload")
         sys.exit(1)
+
+    # --- 2c. optional halo/ghost exchange (the public halo API) ---------
+    if args.halo:
+        # ghosts for the owner-placed state from step 1: every shard
+        # receives copies of neighbor particles within `width` of its
+        # faces, shifted into its frame across the periodic wraps
+        width = 0.25 * min(rd.grid.cell_widths(domain))
+        hres = rd.halo(res.positions, res.fields[0], width=width,
+                       count=res.count)
+        gcount = np.asarray(hres.ghost_count)
+        assert int(np.asarray(hres.overflow).sum()) == 0, (
+            "halo overflow after auto-grow"
+        )
+        print(f"\nhalo exchange: width {width:.3f} -> "
+              f"{int(gcount.sum())} ghosts "
+              f"(per rank: {', '.join(str(int(c)) for c in gcount)}); "
+              "zero overflow")
 
     # --- 3. optional density plot ---------------------------------------
     if args.plot:

@@ -37,6 +37,8 @@ _RULE_DOCS = {
     "program_id-derived indices must be bounded",
     "G006": "no sorts or arange-indexed full-array takes inside "
     "fastpath-engine-marked functions (mover-sparse cost contract)",
+    "G007": "no jax imports or device syncs in scrape-path-marked "
+    "modules (the metrics plane is host-only)",
 }
 
 

@@ -1,0 +1,101 @@
+"""G007 — scrape-path modules must never touch the device.
+
+The metrics plane (ISSUE 5) promises that a Prometheus scrape of
+``/metrics`` or ``/healthz`` is a pure host-side fold over the journal:
+``metrics.from_journal`` replays already-recorded events and
+``aggregate.merge_journals`` k-way merges JSONL rows — no jax import,
+no device fetch, no implicit ``block_until_ready``. The contract is the
+observability twin of G002's no-blocking-device-reads rule for the jit
+step loop: a scraper polling every few seconds must not be able to
+stall (or be stalled by) an in-flight collective, and a metrics module
+that quietly grows a ``jax`` import also grows a multi-second import
+tax onto every ``curl localhost:9100/metrics``.
+
+A module opts into the contract with a marker comment on a line of its
+own (conventionally right under the docstring)::
+
+    # gridlint: scrape-path
+
+Inside a marked module the rule flags:
+
+* any ``import jax`` / ``from jax ... import`` — the whole package is
+  off-limits, not just the sync entry points: importing it is how the
+  device creeps in;
+* device-sync call sites by name — ``block_until_ready``,
+  ``device_get``, ``device_put`` — so even an indirect handle (a jax
+  array smuggled in through a journal payload) cannot be synced here.
+
+The static scan is the fast half of a two-layer defence; the tier-1
+test ``tests/test_metrics.py`` asserts the same property over the
+module sources so a baseline entry cannot grandfather a violation.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    Project,
+    call_name,
+    last_attr,
+    rule,
+)
+
+_MARKER_RE = re.compile(r"#\s*gridlint:\s*scrape-path\b")
+_SYNC_NAMES = ("block_until_ready", "device_get", "device_put")
+
+
+def _is_marked(mod) -> bool:
+    return any(_MARKER_RE.search(line) for line in mod.lines)
+
+
+def _root_module(node: ast.AST) -> str:
+    if isinstance(node, ast.Import):
+        return node.names[0].name.split(".")[0]
+    if isinstance(node, ast.ImportFrom):
+        return (node.module or "").split(".")[0]
+    return ""
+
+
+@rule("G007")
+def check_scrape_path(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if not _is_marked(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if _root_module(node) == "jax":
+                    findings.append(
+                        Finding(
+                            "G007",
+                            mod.relpath,
+                            node.lineno,
+                            node.col_offset,
+                            "jax import inside a scrape-path-marked "
+                            "module — the metrics/aggregation plane is "
+                            "host-only; a scrape must never be able to "
+                            "touch (or wait on) the device",
+                            "<module>",
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                tail = last_attr(call_name(node))
+                if tail in _SYNC_NAMES:
+                    findings.append(
+                        Finding(
+                            "G007",
+                            mod.relpath,
+                            node.lineno,
+                            node.col_offset,
+                            f"{tail} inside a scrape-path-marked module "
+                            f"— device syncs are forbidden on the "
+                            f"scrape path; fold host-side journal rows "
+                            f"only",
+                            "<module>",
+                        )
+                    )
+    return findings

@@ -35,6 +35,7 @@ from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
 from mpi_grid_redistribute_tpu.parallel.halo import HaloResult
 from mpi_grid_redistribute_tpu.telemetry import flow as flow_lib
 from mpi_grid_redistribute_tpu.telemetry import health as health_lib
+from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
 from mpi_grid_redistribute_tpu.telemetry import recorder as telemetry_lib
 from mpi_grid_redistribute_tpu.telemetry import report as report_lib
 from mpi_grid_redistribute_tpu.telemetry import traceview as traceview_lib
@@ -1203,6 +1204,19 @@ class GridRedistribute:
         via ``rd.monitor.add_callback``. Host-side only — never syncs
         the device."""
         return self.monitor.evaluate()
+
+    def metrics(self, render: bool = False):
+        """The scrapable metrics plane over this instance's journal
+        (:mod:`~.telemetry.metrics`): replays ``rd.telemetry`` into the
+        standard grid metric families. Returns the
+        :class:`~.telemetry.metrics.MetricsRegistry`; ``render=True``
+        returns the OpenMetrics text instead (what
+        ``scripts/metrics_serve.py`` serves on ``/metrics``). Counter
+        families use the journal's all-time counts, so totals are exact
+        even after ring eviction. Host-side only — never syncs the
+        device."""
+        reg = metrics_lib.from_journal(self.telemetry)
+        return reg.render_openmetrics() if render else reg
 
     def to_perfetto(self, path: Optional[str] = None, **kwargs):
         """Export this instance's journal as Chrome-trace/Perfetto JSON

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import Optional, Tuple
@@ -20,6 +21,28 @@ def log(msg: str) -> None:
 def emit(result: dict) -> None:
     """The one-JSON-line contract shared with the repo-root bench.py."""
     print(json.dumps(result), flush=True)
+
+
+def write_journal_shard(recorder, name: str) -> Optional[str]:
+    """Write a driver's recorder as a per-process JSONL journal shard.
+
+    ``BENCH_JOURNAL_DIR=dir`` opts in (the bench contract stays
+    one-JSON-line on stdout either way); the shard lands at
+    ``dir/<name>.<host>.<pid>.jsonl`` — every line tagged with the
+    recorder's ``host``/``pid``, ready for
+    ``telemetry.aggregate.merge_journals`` /
+    ``scripts/metrics_serve.py --journal``. Returns the path written, or
+    None when the env var is unset."""
+    out_dir = os.environ.get("BENCH_JOURNAL_DIR")
+    if not out_dir:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(
+        out_dir, f"{name}.{recorder.host}.{recorder.pid}.jsonl"
+    )
+    n = recorder.to_jsonl(path)
+    log(f"journal shard: {path} ({n} events)")
+    return path
 
 
 def pick_layout(grid_shape: Tuple[int, ...]):

@@ -206,6 +206,7 @@ def run(n_total: int = None, reps: int = 3) -> dict:
     rd_api.flush_overflow_checks()  # on_overflow='ignore' makes this a
     # no-op today, but the driver contract is: no unresolved windows left
     api_report = rd_api.report(step_seconds=api_per_step)
+    common.write_journal_shard(rd_api.telemetry, "config1_oracle")
 
     out = {
         "metric": "config1_redistribute_pps",

@@ -96,6 +96,9 @@ def run(
     monitor = telemetry.HealthMonitor(rec)
     monitor.note_step_time(per_step)
     verdict = monitor.evaluate()
+    # BENCH_JOURNAL_DIR=dir: persist this process's journal as a shard
+    # for pod-wide aggregation (metrics_serve --journal / merge_journals)
+    common.write_journal_shard(rec, "config4_drift")
     res = {
         "metric": "config4_drift_pps_per_chip",
         "value": round(total / per_step / n_chips, 2),

@@ -38,7 +38,24 @@ The grid observatory (PR 3) adds three layers on that substrate:
   phase attributions and migrate counter tracks
   (``scripts/trace_export.py``; ``rd.to_perfetto()``).
 
-Event schema: ``telemetry/SCHEMA.md``.
+The metrics plane (ISSUE 5) makes the journal scrapable pod-wide:
+
+* :mod:`.metrics` — Counter/Gauge/Histogram (pow2 buckets) registry,
+  ``from_journal()`` replay into standard grid families, OpenMetrics
+  text rendering (``render_openmetrics``); served live by
+  ``scripts/metrics_serve.py`` (``/metrics`` + ``/healthz``) and
+  reachable as ``rd.metrics()``.
+* :mod:`.aggregate` — multi-host journal aggregation:
+  ``merge_journals()`` k-way merges per-process JSONL shards
+  (``host``/``pid``-tagged lines) with monotone-repaired clock
+  alignment; the :class:`~.aggregate.MergedJournal` projects back into
+  a pod-wide recorder, ``MigrateStats``-shaped pod stats for
+  :func:`~.report.exchange_report`, and merged flow gauges.
+* :mod:`.regress` additionally grew the noise-aware classifier
+  (``classify_capture`` — WOBBLE/WARN/REGRESSION against the captures'
+  own min-of-k spreads) and ``env_fingerprint()``.
+
+Event schema and metric families: ``telemetry/SCHEMA.md``.
 """
 
 from mpi_grid_redistribute_tpu.telemetry.recorder import (  # noqa: F401
@@ -61,8 +78,22 @@ from mpi_grid_redistribute_tpu.telemetry.report import (  # noqa: F401
 )
 from mpi_grid_redistribute_tpu.telemetry.regress import (  # noqa: F401
     check_capture,
+    classify_capture,
+    classify_delta,
+    env_fingerprint,
     extract_metrics,
     min_of_k,
+    noise_floor,
+)
+from mpi_grid_redistribute_tpu.telemetry.metrics import (  # noqa: F401
+    MetricsRegistry,
+    from_journal,
+    pow2_edges,
+    render_openmetrics,
+)
+from mpi_grid_redistribute_tpu.telemetry.aggregate import (  # noqa: F401
+    MergedJournal,
+    merge_journals,
 )
 from mpi_grid_redistribute_tpu.telemetry.flow import (  # noqa: F401
     FlowAccumulator,

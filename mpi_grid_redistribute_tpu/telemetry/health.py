@@ -236,7 +236,15 @@ class HealthMonitor:
         """Journal one measured step time (feeds ``step_time_spike``)."""
         self.recorder.record("step_time", seconds=float(seconds))
 
-    def evaluate(self) -> Dict[str, object]:
+    def evaluate(self, record: bool = True) -> Dict[str, object]:
+        """Run every rule over the journal; returns the verdict dict.
+
+        ``record=False`` is the scrape path (``/healthz`` in
+        ``scripts/metrics_serve.py``): rules run and the verdict is
+        returned, but nothing is journaled, no callbacks fire, and the
+        dedup state is untouched — an external poller hitting the
+        endpoint every few seconds must observe health, not mutate it.
+        """
         findings: List[Finding] = []
         # dedup clock: non-alert events ever journaled — the alert events
         # this pass records must not count as "new evidence" for the next
@@ -245,10 +253,13 @@ class HealthMonitor:
         for rule in self.rules:
             reason = rule.fn(rec)
             if reason is None:
-                self._seen.pop(rule.name, None)
+                if record:
+                    self._seen.pop(rule.name, None)
                 continue
             f = Finding(rule.name, rule.severity, reason)
             findings.append(f)
+            if not record:
+                continue
             if self._seen.get(rule.name) == (reason, seq):
                 continue  # same finding, no new events: don't re-journal
             rec.record(

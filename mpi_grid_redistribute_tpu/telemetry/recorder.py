@@ -31,6 +31,8 @@ from __future__ import annotations
 import collections
 import io
 import json
+import os
+import socket
 import time
 from typing import Dict, List, NamedTuple, Optional
 
@@ -46,12 +48,16 @@ class Event(NamedTuple):
     kind: str
     data: dict
 
-    def to_json(self) -> str:
-        return json.dumps(
-            {"seq": self.seq, "time": self.time, "kind": self.kind,
-             **self.data},
-            sort_keys=True,
-        )
+    def to_json(self, tags: Optional[dict] = None) -> str:
+        """JSON for one journal line; ``tags`` adds envelope fields
+        (e.g. the recorder's ``host``/``pid``) without touching the
+        payload — payload keys win on collision so replayed journals
+        round-trip."""
+        doc = {"seq": self.seq, "time": self.time, "kind": self.kind}
+        if tags:
+            doc.update(tags)
+        doc.update(self.data)
+        return json.dumps(doc, sort_keys=True)
 
 
 class StepRecorder:
@@ -62,9 +68,21 @@ class StepRecorder:
     distinguish "no growth events ever" from "growth events scrolled
     off". ``enabled=False`` turns :meth:`record` into a no-op counter
     bump — the shape of the API stays, the memory goes away.
+
+    ``host``/``pid`` identify the writing process on every exported
+    journal line (multi-host shard merging keys on them; see
+    :mod:`.aggregate`). They default to this process but are
+    overridable — pod emulations on one machine label virtual hosts,
+    and shard replay preserves the original writer.
     """
 
-    def __init__(self, capacity: int = 4096, enabled: bool = True):
+    def __init__(
+        self,
+        capacity: int = 4096,
+        enabled: bool = True,
+        host: Optional[str] = None,
+        pid: Optional[int] = None,
+    ):
         if int(capacity) < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self._ring: collections.deque = collections.deque(
@@ -73,6 +91,8 @@ class StepRecorder:
         self._counts: Dict[str, int] = {}
         self._seq = 0
         self.enabled = bool(enabled)
+        self.host = socket.gethostname() if host is None else str(host)
+        self.pid = os.getpid() if pid is None else int(pid)
 
     @property
     def capacity(self) -> int:
@@ -101,6 +121,18 @@ class StepRecorder:
         if self.enabled:
             self._ring.append(Event(self._seq, time.time(), kind, data))
 
+    def record_at(self, kind: str, when: Optional[float], **data) -> None:
+        """:meth:`record` with an explicit wall time — the replay path.
+
+        Journal rehydration (``scripts/trace_export.py``) and multi-host
+        shard merging (:mod:`.aggregate`) re-record events that already
+        happened; stamping them with *this* process's clock would destroy
+        the cross-shard ordering the merge just computed. ``when=None``
+        falls back to ``time.time()`` (same as :meth:`record`)."""
+        self.record(kind, **data)
+        if self.enabled and when is not None:
+            self._ring[-1] = self._ring[-1]._replace(time=float(when))
+
     def events(self, kind: Optional[str] = None) -> List[Event]:
         """Retained events, oldest first; optionally filtered by kind."""
         if kind is None:
@@ -124,19 +156,23 @@ class StepRecorder:
     def to_jsonl(self, path_or_file) -> int:
         """Write retained events as JSON Lines; returns events written.
 
-        Accepts a path or an open text file. The export is the retained
-        window only — pair with :meth:`counts` (exact all-time totals)
-        when the ring may have wrapped.
+        Accepts a path or an open text file. Every line carries the
+        recorder's ``host``/``pid`` envelope tags so shards from
+        different processes stay attributable after they are merged
+        (SCHEMA.md "Envelope"). The export is the retained window only —
+        pair with :meth:`counts` (exact all-time totals) when the ring
+        may have wrapped.
         """
         events = self.events()
+        tags = {"host": self.host, "pid": self.pid}
         if isinstance(path_or_file, (str, bytes)):
             with open(path_or_file, "w") as f:
                 for e in events:
-                    f.write(e.to_json() + "\n")
+                    f.write(e.to_json(tags) + "\n")
         else:
             f = path_or_file
             for e in events:
-                f.write(e.to_json() + "\n")
+                f.write(e.to_json(tags) + "\n")
         return len(events)
 
     def dumps_jsonl(self) -> str:

@@ -10,21 +10,35 @@ comparison (VERDICT Weak #4). This module closes both gaps:
   ``(max - min)/min`` so a capture carries its own noise floor. A 10%
   regression gate over captures whose spread is 30% is meaningless; the
   spread in the JSON is what makes the gate honest.
-* :func:`check_capture` — the gate: compare a current capture against the
-  committed ``BENCH_r*.json`` history and fail (nonzero exit from the
-  CLI, report lines either way) when throughput drops more than
-  ``threshold`` below the BEST committed value. Best, not latest: a slow
-  drift of back-to-back sub-threshold regressions must not ratchet the
-  reference down with it.
+* :func:`check_capture` — the hard gate: compare a current capture
+  against the committed ``BENCH_r*.json`` history and fail (report lines
+  either way) when throughput drops more than ``threshold`` below the
+  BEST committed value. Best, not latest: a slow drift of back-to-back
+  sub-threshold regressions must not ratchet the reference down with it.
+* :func:`classify_capture` — the noise-aware layer on top (ISSUE 5):
+  instead of one binary threshold, each delta is labeled
+  ``OK`` / ``WOBBLE`` / ``WARN`` / ``REGRESSION`` against a per-metric
+  noise floor derived from the captures' own recorded ``timing_spread``
+  (the min-of-k spread above). The calibration case is r04→r05: the
+  headline moved 799.6M → 736.4M pps (−7.9%) with *byte-identical*
+  ``exchange_bytes_per_step`` — pure wall-clock wobble that the hard
+  gate can neither flag as noise nor tell apart from a real hot-path
+  regression. The classifier labels it WOBBLE; a 2× slowdown labels
+  REGRESSION. Only REGRESSION fails the CLI gate.
+* :func:`env_fingerprint` — captures record the environment they ran in
+  (jax/numpy versions, backend, device kind, flags); the classifier
+  notes fingerprint drift vs the best capture, because "the machine
+  changed" is the most common non-regression explanation for a WARN.
 
 CLI (wired as ``make bench-check``)::
 
     python -m mpi_grid_redistribute_tpu.telemetry.regress \
         [--current CAPTURE.json] [--history 'BENCH_r*.json'] \
-        [--threshold 0.10]
+        [--threshold 0.10] [--legacy]
 
 With no ``--current``, the newest history capture is checked against the
-rest — the self-test mode CI runs on every commit.
+rest — the self-test mode CI runs on every commit. ``--legacy`` restores
+the pre-classifier binary gate.
 """
 
 from __future__ import annotations
@@ -32,6 +46,8 @@ from __future__ import annotations
 import argparse
 import glob
 import json
+import os
+import platform as _platform
 import sys
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -163,6 +179,210 @@ def check_capture(
     return ok, lines
 
 
+# ---------------------------------------------------------------------------
+# Noise-aware classification (ISSUE 5).
+
+# Spread substituted for captures that predate the min-of-k protocol
+# (r01–r05 carry no timing_spread). Calibrated from the one measured
+# wobble in the committed history: r04→r05 moved the headline 8.6% on
+# byte-identical exchange work (BENCH_CONFIGS.md), so pre-spread
+# captures are assumed ~8% noisy.
+DEFAULT_SPREAD = 0.08
+# Safety margin on the spread-derived floor: spread is (max-min)/min of
+# k samples — an underestimate of the true run-to-run envelope for
+# small k.
+SPREAD_MARGIN = 1.25
+# A delta is REGRESSION only beyond max(threshold, this factor × noise):
+# clearly outside anything the captures' own variance can explain.
+REGRESSION_FACTOR = 2.0
+
+# classification labels, worst first
+REGRESSION, WARN, WOBBLE, OK = "REGRESSION", "WARN", "WOBBLE", "OK"
+_SEVERITY = {REGRESSION: 3, WARN: 2, WOBBLE: 1, OK: 0}
+
+# fingerprint keys whose drift invalidates naive cross-capture deltas
+_FP_COMPARE_KEYS = (
+    "jax", "backend", "device_kind", "device_count", "xla_flags"
+)
+
+
+def env_fingerprint() -> Dict[str, object]:
+    """The environment a capture ran in, for cross-capture comparisons.
+
+    Recorded by bench.py under the ``env`` key of every capture. jax is
+    probed only if importable (this module itself must stay importable
+    on a host with no accelerator stack); device queries are best-effort
+    — bench callers have already initialized the backend, so the normal
+    path records real device kinds."""
+    fp: Dict[str, object] = {
+        "python": _platform.python_version(),
+        "platform": sys.platform,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+    try:
+        import numpy
+
+        fp["numpy"] = numpy.__version__
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        import jax
+
+        fp["jax"] = jax.__version__
+        devs = jax.devices()
+        fp["backend"] = devs[0].platform
+        fp["device_kind"] = devs[0].device_kind
+        fp["device_count"] = len(devs)
+    except Exception:  # jax absent or backend init failed: still usable
+        pass
+    return fp
+
+
+def _spread_of(capture: dict) -> Optional[float]:
+    """The capture's own recorded min-of-k spread, if it has one."""
+    parsed = capture.get("parsed", capture)
+    if not isinstance(parsed, dict):
+        return None
+    v = parsed.get("timing_spread")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _env_of(capture: dict) -> Optional[dict]:
+    parsed = capture.get("parsed", capture)
+    if not isinstance(parsed, dict):
+        return None
+    env = parsed.get("env")
+    return env if isinstance(env, dict) else None
+
+
+def noise_floor(
+    current_spread: Optional[float],
+    best_spread: Optional[float],
+) -> Tuple[float, bool]:
+    """Per-metric noise floor from the two captures being compared.
+
+    ``SPREAD_MARGIN × max(spread_current, spread_best)``, substituting
+    :data:`DEFAULT_SPREAD` for captures that predate the min-of-k
+    protocol. Returns ``(floor, defaulted)`` — ``defaulted`` is True
+    when either side used the substitute (the report says so, because a
+    defaulted floor is an assumption, not a measurement)."""
+    defaulted = current_spread is None or best_spread is None
+    cur = DEFAULT_SPREAD if current_spread is None else float(current_spread)
+    best = DEFAULT_SPREAD if best_spread is None else float(best_spread)
+    return SPREAD_MARGIN * max(cur, best), defaulted
+
+
+def classify_delta(
+    delta: float, noise: float, threshold: float = 0.10
+) -> str:
+    """Label one signed relative delta (positive = worse).
+
+    ``OK`` — at or better than best; ``WOBBLE`` — worse but within the
+    noise floor (run-to-run variance explains it); ``REGRESSION`` —
+    beyond ``max(threshold, REGRESSION_FACTOR × noise)`` (variance
+    cannot explain it); ``WARN`` — the gap between (suspicious, rerun
+    before trusting either way)."""
+    if delta <= 0:
+        return OK
+    if delta <= noise:
+        return WOBBLE
+    if delta > max(threshold, REGRESSION_FACTOR * noise):
+        return REGRESSION
+    return WARN
+
+
+def classify_capture(
+    current: dict,
+    history: Sequence[dict],
+    threshold: float = 0.10,
+) -> Tuple[bool, List[str], Dict[str, str]]:
+    """Noise-aware gate: returns ``(ok, report_lines, labels)``.
+
+    Same best-of-history comparison as :func:`check_capture`, but each
+    guarded metric is labeled via :func:`classify_delta` with a noise
+    floor from the current and best captures' recorded spreads
+    (:func:`noise_floor`). ``ok`` is False only on REGRESSION — WOBBLE
+    and WARN report loudly but do not fail the gate, so wall-clock
+    wobble (r04→r05) cannot block an unrelated commit while a real 2×
+    slowdown still does. ``labels`` maps metric name → label for the
+    metrics actually compared."""
+    lines: List[str] = []
+    labels: Dict[str, str] = {}
+    cur = extract_metrics(current)
+    if cur is None:
+        return (
+            False,
+            ["REGRESSION  current capture has no parsed bench metrics"],
+            {},
+        )
+    entries = [
+        (m, _spread_of(h), _env_of(h))
+        for h, m in ((h, extract_metrics(h)) for h in history)
+        if m
+    ]
+    if not entries:
+        return False, ["REGRESSION  no usable history captures"], {}
+    cur_spread = _spread_of(current)
+    cur_env = _env_of(current)
+    ok = True
+    best_env: Optional[dict] = None
+    for name, direction in GUARDED_METRICS.items():
+        vals = [
+            (m[name], spread, env)
+            for m, spread, env in entries
+            if name in m
+        ]
+        if name not in cur or not vals:
+            which = "current" if name not in cur else "history"
+            lines.append(f"skip        {name}: no {which} value")
+            continue
+        pick = max if direction == "higher" else min
+        best, b_spread, b_env = pick(vals, key=lambda v: v[0])
+        if best == 0:
+            lines.append(f"skip        {name}: zero best in history")
+            continue
+        if name == "value":
+            best_env = b_env
+        delta = (
+            (best - cur[name]) / best
+            if direction == "higher"
+            else (cur[name] - best) / best
+        )
+        noise, defaulted = noise_floor(cur_spread, b_spread)
+        label = classify_delta(delta, noise, threshold)
+        labels[name] = label
+        if label == REGRESSION:
+            ok = False
+        bound = max(threshold, REGRESSION_FACTOR * noise)
+        lines.append(
+            f"{label:<10}  {name}: current {cur[name]:.6g} vs best "
+            f"{best:.6g} (Δ {-delta*100:+.1f}%, noise floor "
+            f"{noise*100:.1f}%{' [default spread]' if defaulted else ''},"
+            f" regress bound {bound*100:.1f}%, n_history={len(vals)})"
+        )
+    if cur_env is not None and best_env is not None:
+        drift = [
+            k
+            for k in _FP_COMPARE_KEYS
+            if cur_env.get(k) != best_env.get(k)
+        ]
+        if drift:
+            lines.append(
+                "note        env fingerprint drifted vs best capture: "
+                + ", ".join(
+                    f"{k} {best_env.get(k)!r}→{cur_env.get(k)!r}"
+                    for k in drift
+                )
+            )
+    elif cur_env is not None:
+        lines.append(
+            "note        best capture has no env fingerprint (predates"
+            " it); deltas assume a comparable machine"
+        )
+    return ok, lines, labels
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         description="Bench regression guard: compare a capture against "
@@ -179,6 +399,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="glob of committed captures (default BENCH_r*.json)",
     )
     p.add_argument("--threshold", type=float, default=0.10)
+    p.add_argument(
+        "--legacy",
+        action="store_true",
+        help="use the pre-classifier binary gate (any >threshold delta "
+        "fails) instead of the WOBBLE/WARN/REGRESSION classifier",
+    )
     args = p.parse_args(argv)
 
     paths = sorted(glob.glob(args.history))
@@ -197,10 +423,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 0
         print(f"checking {paths[-1]} against {len(hist_paths)} earlier captures")
     history = [_load(pth) for pth in hist_paths]
-    ok, lines = check_capture(current, history, args.threshold)
+    if args.legacy:
+        ok, lines = check_capture(current, history, args.threshold)
+        verdict = "ok" if ok else "FAIL"
+    else:
+        ok, lines, labels = classify_capture(
+            current, history, args.threshold
+        )
+        worst = max(
+            (label for label in labels.values()),
+            key=lambda s: _SEVERITY[s],
+            default=OK,
+        )
+        verdict = "FAIL (REGRESSION)" if not ok else (
+            "ok" if worst == OK else f"ok ({worst})"
+        )
     for ln in lines:
         print("  " + ln)
-    print(f"bench-check {'ok' if ok else 'FAIL'}")
+    print(f"bench-check {verdict}")
     return 0 if ok else 1
 
 

@@ -6,7 +6,7 @@ Usage:
     python scripts/gridlint.py --list-rules
 
 See mpi_grid_redistribute_tpu/analysis/__init__.py for the rule table
-(G001-G005), suppression syntax, and baseline semantics. The analysis
+(G001-G007), suppression syntax, and baseline semantics. The analysis
 itself is pure-stdlib ``ast`` work; nothing it scans is executed.
 """
 

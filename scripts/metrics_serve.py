@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Serve the grid metrics plane over HTTP (`make serve-metrics`).
+
+Thin stdlib ``http.server`` front-end over
+:mod:`mpi_grid_redistribute_tpu.telemetry.metrics` /
+:mod:`...telemetry.aggregate`. Two endpoints:
+
+* ``GET /metrics`` — OpenMetrics text. The registry is rebuilt from the
+  journal source on EVERY scrape (the "re-snapshot" contract): counters
+  are the recorder's exact all-time counts, gauges/histograms cover the
+  retained window at scrape time. No device work happens on this path —
+  the journal is host memory (or files), and the metrics/aggregate
+  modules never import jax.
+* ``GET /healthz`` — JSON health verdict from a ``HealthMonitor`` run
+  read-only over the same journal (``evaluate(record=False)`` — a
+  poller must observe health, not mutate the journal it is judging).
+  HTTP 200 on OK/WARN, 503 on ALERT, so a plain liveness probe can act
+  on it without parsing.
+
+Journal sources, combinable:
+
+* ``--journal FILE`` (repeatable) — JSONL shard(s) written by
+  ``StepRecorder.to_jsonl``; several shards are pod-merged via
+  ``aggregate.merge_journals`` (``--align wall|start``) and re-read on
+  every scrape, so a live run appending shards is picked up.
+* ``--demo`` — no artifacts handy: run a small in-process drift loop in
+  a background thread and scrape its live recorder.
+
+Examples:
+
+  # serve a bench run's shards pod-wide on :9100
+  python scripts/metrics_serve.py --journal shard0.jsonl \\
+      --journal shard1.jsonl --port 9100
+
+  # self-contained demo; --once prints one scrape and exits (CI)
+  python scripts/metrics_serve.py --demo --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+
+def journal_snapshotter(paths, align):
+    """Snapshot factory over JSONL shard files: re-reads and re-merges
+    on every call, so scrapes track a journal that is still growing."""
+    from mpi_grid_redistribute_tpu import telemetry
+
+    def snapshot():
+        merged = telemetry.merge_journals(paths, align=align)
+        rec = merged.to_recorder(pod_steps=len(merged.shards) > 1)
+        return rec
+
+    return snapshot
+
+
+def demo_snapshotter(steps: int = 200):
+    """Run a small redistribute loop in a background thread; scrapes
+    snapshot its recorder live. Uses the numpy backend — the demo is
+    about the metrics surface, not the engines."""
+    import numpy as np
+
+    from mpi_grid_redistribute_tpu import api
+    from mpi_grid_redistribute_tpu.domain import Domain
+
+    rd = api.GridRedistribute(
+        Domain(0.0, 1.0, periodic=True), (2, 2, 2), backend="numpy"
+    )
+    rng = np.random.default_rng(0)
+    stop = threading.Event()
+
+    def drive():
+        n = 4096
+        pos = rng.random((n, 3), dtype=np.float32)
+        vel = 0.1 * (rng.random((n, 3), dtype=np.float32) - 0.5)
+        for _ in range(steps):
+            if stop.is_set():
+                return
+            t0 = time.perf_counter()
+            rd.redistribute(pos, vel)
+            rd.monitor.note_step_time(time.perf_counter() - t0)
+            rd.monitor.evaluate()
+            pos = (pos + 0.05 * vel) % 1.0
+        stop.set()
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+
+    def snapshot():
+        return rd.telemetry
+
+    return snapshot
+
+
+def make_handler(snapshot):
+    """An HTTPRequestHandler bound to a journal snapshot factory."""
+    from mpi_grid_redistribute_tpu import telemetry
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _send(self, code, ctype, body: bytes):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                rec = snapshot()
+                text = telemetry.from_journal(rec).render_openmetrics()
+                self._send(
+                    200, OPENMETRICS_CONTENT_TYPE, text.encode("utf-8")
+                )
+            elif path == "/healthz":
+                rec = snapshot()
+                monitor = telemetry.HealthMonitor(rec)
+                verdict = monitor.evaluate(record=False)
+                body = (json.dumps(verdict, sort_keys=True) + "\n").encode(
+                    "utf-8"
+                )
+                code = 503 if verdict["status"] == "ALERT" else 200
+                self._send(code, "application/json; charset=utf-8", body)
+            else:
+                self._send(
+                    404,
+                    "text/plain; charset=utf-8",
+                    b"try /metrics or /healthz\n",
+                )
+
+        def log_message(self, fmt, *args):
+            print("  " + fmt % args, file=sys.stderr)
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Serve /metrics (OpenMetrics) + /healthz over a "
+        "telemetry journal."
+    )
+    p.add_argument(
+        "--journal",
+        action="append",
+        default=[],
+        metavar="FILE",
+        help="JSONL journal shard (repeat for a pod merge); re-read on "
+        "every scrape",
+    )
+    p.add_argument(
+        "--align",
+        choices=("wall", "start"),
+        default="wall",
+        help="multi-shard clock alignment (see aggregate.merge_journals)",
+    )
+    p.add_argument(
+        "--demo",
+        action="store_true",
+        help="serve a live in-process drift-loop journal",
+    )
+    p.add_argument("--port", type=int, default=9100,
+                   help="0 = ephemeral (bound port is printed)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--once",
+        action="store_true",
+        help="print one /metrics scrape + the /healthz verdict to "
+        "stdout and exit (no server)",
+    )
+    args = p.parse_args(argv)
+
+    if not args.journal and not args.demo:
+        p.error("need --journal FILE (repeatable) or --demo")
+    if args.journal and args.demo:
+        p.error("--journal and --demo are mutually exclusive")
+
+    from mpi_grid_redistribute_tpu import telemetry
+
+    if args.journal:
+        snapshot = journal_snapshotter(args.journal, args.align)
+    else:
+        snapshot = demo_snapshotter()
+
+    if args.once:
+        rec = snapshot()
+        sys.stdout.write(telemetry.from_journal(rec).render_openmetrics())
+        verdict = telemetry.HealthMonitor(rec).evaluate(record=False)
+        print("healthz: " + json.dumps(verdict, sort_keys=True))
+        return 0
+
+    server = http.server.ThreadingHTTPServer(
+        (args.host, args.port), make_handler(snapshot)
+    )
+    host, port = server.server_address[:2]
+    print(f"serving http://{host}:{port}/metrics and /healthz "
+          "(Ctrl-C to stop)", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("stopped")
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

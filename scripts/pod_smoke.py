@@ -42,7 +42,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 
-def main() -> None:
+def main(journal_dir: str = None) -> None:
     # honor a forced virtual CPU mesh (same trick as __graft_entry__ /
     # tests/conftest.py): the baked sitecustomize pins the axon TPU
     # platform, hiding --xla_force_host_platform_device_count devices
@@ -177,6 +177,71 @@ def main() -> None:
         f"migrate loop: OK ({per_step*1e3:.2f} ms/step, "
         f"{total/per_step/R/1e6:.1f}M pps/chip, backlog "
         f"{stall['backlog_final']})", flush=True,
+    )
+
+    # --- 3b: multi-host journal sharding + pod-wide aggregation --------
+    # On a real pod every process journals its own shard; here each rank
+    # of the mesh plays one "host" (its slice of the [S, R] stats) and
+    # the merge must reconstruct the pod totals exactly — the
+    # merge-equals-sum contract of telemetry/aggregate.py. Shards only
+    # hit disk with --journal-dir; the aggregation check always runs.
+    from mpi_grid_redistribute_tpu import telemetry
+
+    shards = []
+    for r in range(R):
+        rec = telemetry.StepRecorder(host=f"host{r:02d}", pid=1000 + r)
+        for s in range(mstats.sent.shape[0]):
+            rec.record(
+                "migrate_step",
+                step=s,
+                sent=int(mstats.sent[s, r]),
+                received=int(mstats.received[s, r]),
+                backlog=int(mstats.backlog[s, r]),
+                dropped_recv=int(mstats.dropped_recv[s, r]),
+                population=int(mstats.population[s, r]),
+            )
+        shards.append(rec)
+    if journal_dir:
+        os.makedirs(journal_dir, exist_ok=True)
+        paths = []
+        for rec in shards:
+            path = os.path.join(
+                journal_dir, f"pod_smoke.{rec.host}.{rec.pid}.jsonl"
+            )
+            rec.to_jsonl(path)
+            paths.append(path)
+        merged = telemetry.merge_journals(paths)
+    else:
+        merged = telemetry.merge_journals(shards)
+    # aggregate counters == sum of per-shard counters
+    want = {"migrate_step": R * int(mstats.sent.shape[0])}
+    assert merged.counts() == want, (merged.counts(), want)
+    assert merged.counts() == {
+        k: sum(c.get(k, 0) for c in merged.per_shard_counts().values())
+        for k in merged.counts()
+    }
+    # pod-wide per-step sums == direct sums over the stats pytree
+    pod_rec = merged.to_recorder(pod_steps=True)
+    pod_sent = sum(
+        e.data["sent"] for e in pod_rec.events("migrate_step")
+    )
+    assert pod_sent == int(mstats.sent.sum()), (
+        pod_sent, int(mstats.sent.sum())
+    )
+    pstats = merged.pod_stats()
+    assert int(pstats.population.sum()) == int(mstats.population.sum())
+    # the scrapable projection agrees with the recorder's exact counts
+    reg = telemetry.from_journal(merged)
+    fam = reg.get("grid_journal_events")
+    scraped = {
+        labels[0]: child.value for labels, child in fam.children()
+    }
+    assert scraped == merged.counts(), (scraped, merged.counts())
+    print(
+        f"journal aggregation: OK ({R} shards, "
+        f"{len(merged)} events merged"
+        + (f", shards in {journal_dir}" if journal_dir else "")
+        + ")", flush=True,
     )
 
     # --- 4: halo exchange (ppermute) -----------------------------------
@@ -353,4 +418,13 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    _p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    _p.add_argument(
+        "--journal-dir",
+        default=os.environ.get("POD_SMOKE_JOURNAL_DIR"),
+        help="write one JSONL journal shard per (virtual) host here; "
+        "the pod-wide aggregation check runs either way",
+    )
+    main(journal_dir=_p.parse_args().journal_dir)

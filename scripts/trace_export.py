@@ -53,12 +53,17 @@ def load_journal(path: str):
             kind = obj.pop("kind")
             obj.pop("seq", None)
             t = obj.pop("time", None)
-            rec.record(kind, **obj)
-            if t is not None:
-                # keep the original wall time so track timestamps are
-                # honest (record() stamped "now")
-                last = rec._ring[-1]
-                rec._ring[-1] = last._replace(time=float(t))
+            # envelope tags (ISSUE 5 multi-host shards) identify the
+            # writer, not the event — keep the rehydrated payload clean
+            # and carry the identity on the recorder itself
+            host, pid = obj.pop("host", None), obj.pop("pid", None)
+            if host is not None:
+                rec.host = str(host)
+            if pid is not None:
+                rec.pid = int(pid)
+            # record_at keeps the original wall time so track
+            # timestamps are honest (record() would stamp "now")
+            rec.record_at(kind, t, **obj)
             n_lines += 1
     if n_lines == 0:
         raise SystemExit(f"{path}: empty journal")

@@ -443,6 +443,69 @@ def test_g006_sees_nested_defs_in_marked_fn(tmp_path):
     assert rules_of(findings) == ["G006"], findings
 
 
+# ---------------------------------------------------------------- G007
+
+
+def test_g007_fires_on_jax_import_and_sync_in_marked_module(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    # gridlint: scrape-path
+    import jax
+    from jax import numpy as jnp
+
+    def scrape(x):
+        return x.block_until_ready()
+    """,
+        },
+        rules=["G007"],
+    )
+    assert rules_of(findings) == ["G007"], findings
+    assert len(findings) == 3, findings  # two imports + one sync
+
+
+def test_g007_quiet_without_marker_and_on_clean_marked_module(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            # jax everywhere, but no scrape-path marker: out of scope
+            "unmarked.py": """
+    import jax
+
+    def f(x):
+        return jax.device_get(x)
+    """,
+            # marked, but host-only: json/math folds are the contract
+            "marked.py": """
+    # gridlint: scrape-path
+    import json
+    import math
+
+    def fold(rows):
+        return {"n": len(rows), "log": math.log2(max(1, len(rows)))}
+    """,
+        },
+        rules=["G007"],
+    )
+    assert findings == [], findings
+
+
+def test_g007_metrics_plane_is_marked_and_clean():
+    # the real modules carry the marker (the contract is opted into, not
+    # implied) and lint clean — the static half of the scrape-path
+    # purity gate (tests/test_metrics.py holds the source-scan half)
+    from mpi_grid_redistribute_tpu.analysis.rules_scrape import _MARKER_RE
+
+    tel = os.path.join(PACKAGE, "telemetry")
+    for name in ("metrics.py", "aggregate.py"):
+        with open(os.path.join(tel, name), encoding="utf-8") as fh:
+            src = fh.read()
+        assert _MARKER_RE.search(src), f"{name} lost its scrape-path marker"
+    findings = run_gridlint([tel], root=REPO_ROOT, rules=["G007"])
+    assert findings == [], findings
+
+
 # ------------------------------------------------- suppressions, baseline
 
 
