@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test lint lint-json baseline bench-check observe serve-metrics
+.PHONY: test lint lint-json baseline bench-check observe serve-metrics soak
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -33,7 +33,18 @@ observe:
 		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
 		--bias --expect-alert
 
-# gridlint: AST-based SPMD/JIT invariant checker (G001-G007).
+# service soak gate (bench/config8_soak.py --soak): short CPU soak of
+# the fault-tolerant service driver with the snapshot cadence on and
+# one injected mid-run crash. Fails (exit 1) unless the supervised
+# restore is bit-identical to an uninterrupted run, exactly one restart
+# happened, and the async-snapshot overhead stays <= 2% of step time
+# (min-of-k). See mpi_grid_redistribute_tpu/service/.
+soak:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		BENCH_SCALE=0.05 \
+		$(PY) -m mpi_grid_redistribute_tpu.bench.config8_soak --soak
+
+# gridlint: AST-based SPMD/JIT invariant checker (G001-G008).
 # Exit 0 = clean or fully baselined; 1 = new findings or stale baseline
 # entries; 2 = usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:

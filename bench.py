@@ -258,6 +258,16 @@ def main() -> None:
 
         stress = config7_stress.run()
 
+    # service soak capture (bench/config8_soak.py): sustained throughput
+    # through the full service loop with the checkpoint cadence ON, plus
+    # the crash/restore leg — guards soak_pps and keeps the <= 2%
+    # snapshot-overhead budget honest across PRs
+    soak = None
+    if os.environ.get("BENCH_SOAK", "1") != "0":
+        from mpi_grid_redistribute_tpu.bench import config8_soak
+
+        soak = config8_soak.run()
+
     print(
         json.dumps(
             {
@@ -297,6 +307,7 @@ def main() -> None:
                     6,
                 ),
                 "stress": stress,
+                "soak": soak,
                 # environment fingerprint (telemetry.regress): the
                 # classifier flags cross-capture deltas whose machine
                 # changed out from under them

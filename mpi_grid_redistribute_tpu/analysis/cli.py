@@ -39,6 +39,8 @@ _RULE_DOCS = {
     "fastpath-engine-marked functions (mover-sparse cost contract)",
     "G007": "no jax imports or device syncs in scrape-path-marked "
     "modules (the metrics plane is host-only)",
+    "G008": "no bare `except:` or swallowed exceptions in "
+    "service-path-marked modules (the supervisor must see every fault)",
 }
 
 

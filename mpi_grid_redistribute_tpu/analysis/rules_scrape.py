@@ -44,7 +44,14 @@ from mpi_grid_redistribute_tpu.analysis.core import (
     rule,
 )
 
-_MARKER_RE = re.compile(r"#\s*gridlint:\s*scrape-path\b")
+def marker_re(tag: str) -> "re.Pattern[str]":
+    """Compile the opt-in marker pattern for ``# gridlint: <tag>`` —
+    shared by the marker-scoped rules (G006 fastpath-engine, G007
+    scrape-path, G008 service-path)."""
+    return re.compile(rf"#\s*gridlint:\s*{re.escape(tag)}\b")
+
+
+_MARKER_RE = marker_re("scrape-path")
 _SYNC_NAMES = ("block_until_ready", "device_get", "device_put")
 
 

@@ -1,0 +1,100 @@
+"""G008 — service-path modules must never mask a fault.
+
+The fault-tolerance story (ISSUE 6) rests on one invariant: every
+failure inside the service loop either surfaces to the supervisor (which
+restores from snapshot) or is journaled as an explicit event — a fault
+that disappears inside an exception handler is silent corruption, the
+one outcome the whole subsystem exists to rule out. The supervisor's
+crash-loop breaker can only count failures it sees.
+
+A module opts into the contract with a marker comment on a line of its
+own (conventionally right under the docstring)::
+
+    # gridlint: service-path
+
+Inside a marked module the rule flags:
+
+* any bare ``except:`` — it catches ``KeyboardInterrupt``/``SystemExit``
+  too, so even an *intentional* hard-exit fault injection (or an
+  operator's Ctrl-C) can be eaten;
+* any handler whose body only discards (every statement is ``pass`` or
+  ``...``) — the canonical swallowed exception. A handler that does real
+  work (journals the failure, narrows and re-raises, converts to a
+  verdict) is fine; the rule polices disposal, not handling.
+
+Like G007, the static scan is the cheap half of the defence — the
+fault-matrix test in ``tests/test_service.py`` asserts the dynamic half
+(every injected fault ends in a journaled recovery or degradation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    Project,
+    rule,
+)
+from mpi_grid_redistribute_tpu.analysis.rules_scrape import marker_re
+
+_MARKER_RE = marker_re("service-path")
+
+
+def _is_marked(mod) -> bool:
+    return any(_MARKER_RE.search(line) for line in mod.lines)
+
+
+def _body_only_discards(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+@rule("G008")
+def check_service_path(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if not _is_marked(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(
+                    Finding(
+                        "G008",
+                        mod.relpath,
+                        node.lineno,
+                        node.col_offset,
+                        "bare `except:` inside a service-path-marked "
+                        "module — it eats SystemExit/KeyboardInterrupt "
+                        "and hides faults the supervisor must see; "
+                        "catch a named exception type",
+                        "<module>",
+                    )
+                )
+            elif _body_only_discards(node.body):
+                findings.append(
+                    Finding(
+                        "G008",
+                        mod.relpath,
+                        node.lineno,
+                        node.col_offset,
+                        "swallowed exception (handler body only "
+                        "discards) inside a service-path-marked module "
+                        "— a masked fault is silent corruption; journal "
+                        "it, convert it to a verdict, or re-raise",
+                        "<module>",
+                    )
+                )
+    return findings

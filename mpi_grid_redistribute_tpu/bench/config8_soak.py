@@ -1,0 +1,238 @@
+"""Config 8: service soak — sustained throughput with snapshots on.
+
+The other configs time the *engine*; this one times the *service*
+(ISSUE 6): the :class:`~..service.driver.ServiceDriver` streaming loop —
+host drift, public-API redistribute, journal, watchdog — with the
+checkpoint cadence enabled, answering two questions the fault-tolerance
+story depends on:
+
+* **What does durability cost?** ``snapshot_overhead`` compares min-of-k
+  segment timings of the same driver loop with snapshots off vs on
+  (async writer). The acceptance gate is <= 2% of step time: if
+  checkpointing costs more than that, nobody leaves it on, and a
+  checkpoint nobody writes restores nothing.
+* **Does recovery actually preserve the trajectory?** The crash leg runs
+  a short supervised soak with one injected mid-run crash, restores from
+  the latest snapshot, and byte-compares the final state against an
+  uninterrupted run — ``bit_identical_resume`` in the capture, gated by
+  ``make soak``.
+
+The headline is ``soak_pps`` (sustained particles/s through the full
+service loop, snapshots on) — guarded by ``bench-check`` like any other
+capture (auto-armed: history captures that predate the field are
+skipped).
+
+Env overrides: ``BENCH_SCALE`` (scales ``n_local``), ``BENCH_GRID``,
+``BENCH_SOAK_N_LOCAL``, ``BENCH_SOAK_EVERY`` (snapshot cadence),
+``BENCH_SOAK_K`` (min-of-k samples).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.bench import common
+
+
+def _grid_and_backend():
+    """The canonical grid on enough devices, else a numpy-backend soak —
+    the service loop is the thing under test, not the mesh."""
+    import jax
+
+    grid = tuple(
+        int(x) for x in os.environ.get("BENCH_GRID", "2,2,2").split(",")
+    )
+    if len(jax.devices()) >= math.prod(grid):
+        return grid, "jax"
+    return grid, "numpy"
+
+
+def _make_driver(grid, backend, n_local, steps, snapshot_every, snap_dir,
+                 recorder=None, faults=None):
+    from mpi_grid_redistribute_tpu.service import DriverConfig, ServiceDriver
+
+    cfg = DriverConfig(
+        grid_shape=grid,
+        n_local=n_local,
+        steps=steps,
+        seed=11,
+        backend=backend,
+        snapshot_every=snapshot_every,
+        snapshot_dir=snap_dir,
+        keep_snapshots=3,
+    )
+    return ServiceDriver(cfg, recorder=recorder, faults=faults)
+
+
+def _segment_seconds(driver, seg: int) -> float:
+    t0 = time.perf_counter()
+    driver.run(max_steps=seg)
+    return (time.perf_counter() - t0) / seg
+
+
+def run(n_local: int = None, reps: int = None) -> dict:
+    """One soak capture: overhead measurement + crash/restore leg."""
+    from mpi_grid_redistribute_tpu.service import (
+        CrashFault,
+        FaultPlan,
+        RestartPolicy,
+        Supervisor,
+    )
+    from mpi_grid_redistribute_tpu.telemetry import StepRecorder, regress
+
+    grid, backend = _grid_and_backend()
+    R = math.prod(grid)
+    if n_local is None:
+        scale = float(os.environ.get("BENCH_SCALE", 1.0))
+        n_local = int(
+            os.environ.get("BENCH_SOAK_N_LOCAL", max(1024, int(scale * (1 << 14))))
+        )
+    every = int(os.environ.get("BENCH_SOAK_EVERY", 16))
+    k = reps if reps is not None else int(os.environ.get("BENCH_SOAK_K", 5))
+    seg = 2 * every  # segment spans 2 cadences: joins land inside samples
+    warm = 4
+    steps = warm + k * seg
+
+    root = tempfile.mkdtemp(prefix="config8_soak_")
+    try:
+        # --- base: identical loop, snapshots off -----------------------
+        base_drv = _make_driver(grid, backend, n_local, steps, 0, None)
+        base_drv.init_state()
+        base_drv.run(max_steps=warm)  # compile + caches
+        base = regress.min_of_k(lambda: _segment_seconds(base_drv, seg), k=k)
+        base_drv.close()
+
+        # --- soak: snapshots on (async writer) -------------------------
+        soak_drv = _make_driver(
+            grid, backend, n_local, steps, every,
+            os.path.join(root, "snaps"),
+        )
+        soak_drv.init_state()
+        soak_drv.run(max_steps=warm)
+        soak = regress.min_of_k(lambda: _segment_seconds(soak_drv, seg), k=k)
+        snapshots = len(soak_drv.recorder.events("snapshot"))
+        soak_fill = soak_drv.cfg.fill
+        soak_drv.close()
+        overhead = (soak["min"] - base["min"]) / base["min"]
+
+        # --- crash leg: one injected crash, supervised restore ---------
+        n_small = max(256, n_local // 8)
+        crash_steps, crash_every, crash_at = 24, 6, 15
+        ref = _make_driver(
+            grid, backend, n_small, crash_steps, crash_every,
+            os.path.join(root, "ref_snaps"),
+        )
+        ref.init_state()
+        ref.run()
+        ref.close()
+
+        rec = StepRecorder()
+        plan = FaultPlan([CrashFault(crash_at)])
+        sup = Supervisor(
+            lambda: _make_driver(
+                grid, backend, n_small, crash_steps, crash_every,
+                os.path.join(root, "soak_snaps"), recorder=rec, faults=plan,
+            ),
+            policy=RestartPolicy(backoff_base_s=0.01, backoff_cap_s=0.05),
+            recorder=rec,
+        )
+        verdict = sup.run()
+        bit_identical = bool(
+            verdict.ok
+            and all(
+                a.tobytes() == b.tobytes()
+                for a, b in zip(ref.state, sup.driver.state)
+            )
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    live = int(soak_fill * n_local) * R
+    out = {
+        "metric": "soak_pps",
+        "value": round(live / soak["min"], 2),
+        "unit": "particles/s",
+        "engine": backend,
+        "grid": list(grid),
+        "rows": live,
+        "ms_per_step": round(soak["min"] * 1e3, 3),
+        "timing_spread": round(soak["spread"], 4),
+        "timing_k": soak["k"],
+        "snapshot_every": every,
+        "snapshots_written": snapshots,
+        "snapshot_overhead": round(overhead, 4),
+        "restarts": verdict.restarts,
+        "bit_identical_resume": bit_identical,
+    }
+    common.log(
+        f"config8: soak {live / soak['min']:.3e} pps "
+        f"({soak['min'] * 1e3:.2f} ms/step, snapshots every {every}), "
+        f"snapshot overhead {overhead * 100:+.2f}%, "
+        f"crash leg: restarts={verdict.restarts} "
+        f"bit_identical={bit_identical}"
+    )
+    return out
+
+
+def _soak_gate(out: dict, overhead_max: float = 0.02) -> list:
+    """The `make soak` verdict: hard failures as a list of reasons."""
+    failures = []
+    if not out["bit_identical_resume"]:
+        failures.append(
+            "resumed trajectory is NOT bit-identical to the "
+            "uninterrupted run"
+        )
+    if out["restarts"] != 1:
+        failures.append(
+            f"crash leg restarted {out['restarts']} times, expected 1"
+        )
+    if out["snapshot_overhead"] > overhead_max:
+        failures.append(
+            f"snapshot overhead {out['snapshot_overhead'] * 100:.2f}% "
+            f"exceeds the {overhead_max * 100:.0f}% budget"
+        )
+    if out["snapshots_written"] < 1:
+        failures.append("soak run wrote no snapshots")
+    return failures
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="config8_soak")
+    p.add_argument(
+        "--soak", action="store_true",
+        help="gate mode (make soak): assert overhead/restore criteria",
+    )
+    p.add_argument(
+        "--overhead-max", type=float,
+        default=float(os.environ.get("SOAK_OVERHEAD_MAX", 0.02)),
+    )
+    args = p.parse_args(argv)
+    out = run()
+    common.emit(out)
+    if not args.soak:
+        return 0
+    failures = _soak_gate(out, args.overhead_max)
+    if failures:
+        for f in failures:
+            common.log(f"soak FAIL: {f}")
+        return 1
+    common.log(
+        f"soak OK: crash+restore bit-identical, snapshot overhead "
+        f"{out['snapshot_overhead'] * 100:.2f}% <= "
+        f"{args.overhead_max * 100:.0f}%"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,49 @@
+"""Fault-tolerant service mode (ISSUE 6; ROADMAP item 3).
+
+The drift→redistribute loop as an always-on supervised service:
+
+* :mod:`.driver` — :class:`ServiceDriver`, the checkpointed streaming
+  loop (snapshot cadence, journal export, watchdog, health-driven
+  engine degradation).
+* :mod:`.supervisor` — :class:`Supervisor` + :class:`RestartPolicy`,
+  restore-from-latest-valid-snapshot with bounded jittered backoff and
+  a crash-loop circuit breaker.
+* :mod:`.faults` — deterministic seeded fault injectors
+  (:class:`FaultPlan`); every survivable failure mode has one.
+"""
+
+from mpi_grid_redistribute_tpu.service.driver import (
+    DriverConfig,
+    ServiceDriver,
+)
+from mpi_grid_redistribute_tpu.service.faults import (
+    CrashFault,
+    FallbackFloodFault,
+    FaultPlan,
+    InjectedCrash,
+    JournalShardLossFault,
+    StallError,
+    StallFault,
+    TornSnapshotFault,
+)
+from mpi_grid_redistribute_tpu.service.supervisor import (
+    RestartPolicy,
+    Supervisor,
+    SupervisorVerdict,
+)
+
+__all__ = [
+    "CrashFault",
+    "DriverConfig",
+    "FallbackFloodFault",
+    "FaultPlan",
+    "InjectedCrash",
+    "JournalShardLossFault",
+    "RestartPolicy",
+    "ServiceDriver",
+    "StallError",
+    "StallFault",
+    "Supervisor",
+    "SupervisorVerdict",
+    "TornSnapshotFault",
+]

@@ -1,0 +1,13 @@
+"""``python -m mpi_grid_redistribute_tpu.service`` — the driver CLI.
+
+(The package entry point, so subprocess callers avoid runpy's
+found-in-sys.modules warning that ``-m ...service.driver`` triggers via
+the package ``__init__`` importing the driver module.)
+"""
+
+import sys
+
+from mpi_grid_redistribute_tpu.service.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

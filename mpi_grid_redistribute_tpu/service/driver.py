@@ -1,0 +1,548 @@
+"""Long-running service driver: the drift→redistribute loop as a process.
+
+Everything else in the repo runs the loop for a fixed number of steps and
+exits with its process; this module is ROADMAP item 3's first half — the
+loop as an *always-on service*. :class:`ServiceDriver` owns the particle
+state, advances it through the public :class:`~..api.GridRedistribute`
+engine step after step, and on a step cadence:
+
+* snapshots the full particle pytree through the hardened
+  ``utils/checkpoint.py`` (atomic publish + per-shard checksums), by
+  default on a background writer thread so the write overlaps the next
+  steps instead of stalling them (the <= 2% overhead budget,
+  ``tests/test_service.py``);
+* exports its journal as a per-process JSONL shard (the metrics plane's
+  scrape substrate), detecting and healing a lost shard;
+* evaluates the :class:`~..telemetry.health.HealthMonitor` rules, and
+  degrades ``engine -> planar`` exactly once if the
+  ``fast_path_fallback`` rule fires (journaled ``degrade``; a one-way
+  ratchet, never flapping).
+
+A wall-clock watchdog turns a stalled step into a
+:class:`~.faults.StallError` — a *failure* the supervisor restarts from
+snapshot, not a silent wait. All state transitions are journaled
+(``snapshot`` / ``restore`` / ``degrade``; see telemetry/SCHEMA.md) so
+the recovery story is auditable from the journal alone.
+
+The step itself is deliberately deterministic: host-side float32 drift +
+periodic wrap, then one public-API redistribute. Restoring a snapshot at
+step k and running to step N is bit-identical to an uninterrupted run to
+N — the property ``pod_smoke --kill-restore`` and the fault-matrix tests
+assert, and the foundation for elastic restarts (a snapshot written at R
+shards reloads at any shard count, ``utils/checkpoint.py``).
+
+CLI (used by ``scripts/pod_smoke.py --kill-restore`` and ``make soak``)::
+
+    python -m mpi_grid_redistribute_tpu.service.driver \\
+        --grid 2,2,2 --steps 60 --snapshot-every 5 --snapshot-dir /tmp/snaps
+
+"""
+# gridlint: service-path
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.service.faults import FaultPlan, StallError
+from mpi_grid_redistribute_tpu.telemetry import StepRecorder
+from mpi_grid_redistribute_tpu.telemetry.health import HealthMonitor
+from mpi_grid_redistribute_tpu.utils import checkpoint
+
+
+@dataclasses.dataclass(frozen=True)
+class DriverConfig:
+    """Static configuration of one service run (hashable, restart-safe:
+    two drivers built from the same config are interchangeable)."""
+
+    grid_shape: Tuple[int, ...] = (2, 2, 2)
+    n_local: int = 4096       # padded rows per shard (state shape, fixed)
+    # live fraction: per-rank population is a bounded random walk around
+    # uniform, so the 1-fill headroom must cover several sigma of
+    # sqrt(live) Poisson-scale skew or a long soak eventually drops
+    # arrivals (0.9 measurably overflows at n_local ~ 1k)
+    fill: float = 0.8
+    steps: int = 64           # service horizon (CLI/tests; soak loops run())
+    dt: float = 1.0
+    seed: int = 0
+    migration: float = 0.02   # ~fraction of live rows crossing a face/step
+    backend: str = "jax"      # "jax" | "numpy" (oracle; meshless)
+    engine: str = "auto"
+    snapshot_every: int = 0   # steps between snapshots; 0 = snapshots off
+    snapshot_dir: Optional[str] = None
+    keep_snapshots: int = 4   # retained snapshots (>= 2: torn-skip fallback)
+    snapshot_async: bool = True
+    journal_dir: Optional[str] = None
+    watchdog_s: float = 0.0   # wall budget per step; 0 = watchdog off
+    health_every: int = 0     # extra health cadence; 0 = at snapshots only
+    step_sleep: float = 0.0   # pacing, so external kills land mid-run
+
+
+class ServiceDriver:
+    """One supervised instance of the streaming loop.
+
+    Lifecycle: ``restore_latest()`` (or ``init_state()``), ``run()``,
+    ``close()``. The supervisor builds a fresh driver per restart from
+    the same config + shared recorder; all recovery state lives in
+    snapshots and the journal, never in the object.
+    """
+
+    def __init__(
+        self,
+        cfg: DriverConfig,
+        recorder: Optional[StepRecorder] = None,
+        monitor: Optional[HealthMonitor] = None,
+        faults: Optional[FaultPlan] = None,
+    ):
+        if cfg.snapshot_every and not cfg.snapshot_dir:
+            raise ValueError("snapshot_every set but snapshot_dir is None")
+        if cfg.snapshot_every and cfg.keep_snapshots < 2:
+            raise ValueError(
+                "keep_snapshots must be >= 2 so a corrupt newest snapshot "
+                "always has a valid predecessor to fall back to"
+            )
+        self.cfg = cfg
+        self.recorder = recorder if recorder is not None else StepRecorder()
+        self.monitor = (
+            monitor if monitor is not None else HealthMonitor(self.recorder)
+        )
+        self.faults = faults if faults is not None else FaultPlan()
+        self.engine = cfg.engine
+        self.degraded = False
+        self.step = 0
+        self.state: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self.journal_path: Optional[str] = None
+        self._rd = None
+        self._wall_ema: Optional[float] = None
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[str] = None
+        self._last_snapshot_path: Optional[str] = None
+
+    # ---------------------------------------------------------- build
+
+    @property
+    def nranks(self) -> int:
+        from mpi_grid_redistribute_tpu.domain import ProcessGrid
+
+        return ProcessGrid(self.cfg.grid_shape).nranks
+
+    def _ensure_built(self) -> None:
+        if self._rd is not None:
+            return
+        from mpi_grid_redistribute_tpu.api import GridRedistribute
+        from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+
+        cfg = self.cfg
+        domain = Domain(0.0, 1.0, periodic=True)
+        grid = ProcessGrid(cfg.grid_shape)
+        kwargs = dict(
+            # capacity = n_local: the self-pair carries every resident row
+            # in a drift regime, so anything smaller guarantees overflow
+            capacity=cfg.n_local,
+            on_overflow="grow",
+            engine=self.engine,
+        )
+        if cfg.backend == "numpy":
+            self._rd = GridRedistribute(
+                domain, grid, backend="numpy", **kwargs
+            )
+        else:
+            from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+            self._rd = GridRedistribute(
+                domain, grid, mesh=mesh_lib.make_mesh(grid), **kwargs
+            )
+        # one journal for the whole service: the engine's own events
+        # (capacity_grow, overflow windows, redistribute) land in the
+        # driver's ring, next to snapshot/restore/fault/restart events
+        self._rd.telemetry = self.recorder
+        self._rd.monitor = self.monitor
+
+    # ---------------------------------------------------------- state
+
+    def init_state(self) -> None:
+        """Fresh seeded state: rows pre-placed on their owning shard
+        (slab-uniform), velocities sized for ``cfg.migration``."""
+        from mpi_grid_redistribute_tpu.bench import common as bcommon
+
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed)
+        v_scale, _, _ = bcommon.drift_sizing(
+            cfg.grid_shape, cfg.n_local, cfg.fill, cfg.migration
+        )
+        pos, vel, _ = bcommon.uniform_state(
+            cfg.grid_shape, cfg.n_local, 1.0, rng, vel_scale=v_scale
+        )
+        count = np.full(
+            (self.nranks,), int(cfg.fill * cfg.n_local), np.int32
+        )
+        self.state = (pos, vel, count)
+        self.step = 0
+
+    def restore_latest(self) -> bool:
+        """Restore from the newest VALID snapshot (corrupt ones are
+        skipped and the skip count journaled). Returns False when no
+        valid snapshot exists — the caller falls back to
+        :meth:`init_state`."""
+        cfg = self.cfg
+        if not cfg.snapshot_dir:
+            return False
+        latest = checkpoint.load_latest(cfg.snapshot_dir)
+        if latest is None:
+            return False
+        a = latest.arrays
+        self.state = (
+            np.asarray(a["pos"], np.float32),
+            np.asarray(a["vel"], np.float32),
+            np.asarray(a["count"], np.int32),
+        )
+        self.step = int(latest.manifest["step"])
+        self.recorder.record(
+            "restore",
+            what="state",
+            step=self.step,
+            path=latest.path,
+            snapshots_skipped=latest.skipped,
+        )
+        return True
+
+    # ------------------------------------------------------ snapshots
+
+    def join_snapshot_writer(self) -> None:
+        """Block until the in-flight async snapshot write (if any) has
+        committed; re-raise its failure — a write error must surface as
+        a driver failure, never vanish into the thread."""
+        t = self._writer
+        if t is not None:
+            t.join()
+            self._writer = None
+        if self._writer_error is not None:
+            err, self._writer_error = self._writer_error, None
+            raise RuntimeError(f"async snapshot write failed: {err}")
+
+    def snapshot(self) -> str:
+        """Write one snapshot of the full particle pytree; journal it."""
+        cfg = self.cfg
+        pos, vel, count = self.state
+        step = self.step
+        path = os.path.join(cfg.snapshot_dir, f"step_{step:08d}")
+        # the state tuple is never mutated in place (_advance returns
+        # fresh arrays), so the writer thread can serialize these exact
+        # arrays without a defensive copy
+        arrays = {"pos": pos, "vel": vel, "count": count}
+        extra = {"seed": cfg.seed, "engine": self.engine}
+
+        def write() -> None:
+            try:
+                checkpoint.save(
+                    path, arrays, nranks=self.nranks, step=step,
+                    extra=extra,
+                )
+            except Exception as e:  # surfaced by join_snapshot_writer
+                self._writer_error = f"{type(e).__name__}: {e}"
+
+        self.join_snapshot_writer()  # at most one write in flight
+        cadence_s = float(cfg.snapshot_every) * float(self._wall_ema or 0.0)
+        self.recorder.record(
+            "snapshot",
+            step=step,
+            path=path,
+            cadence_s=cadence_s,
+            rows=int(count.sum()),
+            asynchronous=bool(cfg.snapshot_async),
+        )
+        if cfg.snapshot_async:
+            t = threading.Thread(target=write, daemon=True)
+            self._writer = t
+            t.start()
+        else:
+            write()
+            self.join_snapshot_writer()
+        self._last_snapshot_path = path
+        self._prune_snapshots()
+        self.export_journal()
+        return path
+
+    def _prune_snapshots(self) -> None:
+        keep = self.cfg.keep_snapshots
+        for path in checkpoint.list_snapshots(self.cfg.snapshot_dir)[keep:]:
+            if path == self._last_snapshot_path:
+                continue  # never the one just written (possibly in flight)
+            import shutil
+
+            shutil.rmtree(path)
+
+    def export_journal(self) -> Optional[str]:
+        """Export the retained journal window as this process's shard.
+
+        A previously exported shard that has vanished (disk fault,
+        operator error — :class:`~.faults.JournalShardLossFault`) is
+        detected here and healed by re-exporting the retained window,
+        with a journaled ``restore`` event so the loss is auditable."""
+        cfg = self.cfg
+        if not cfg.journal_dir:
+            return None
+        os.makedirs(cfg.journal_dir, exist_ok=True)
+        rec = self.recorder
+        path = os.path.join(
+            cfg.journal_dir, f"driver.{rec.host}.{rec.pid}.jsonl"
+        )
+        if self.journal_path is not None and not os.path.exists(
+            self.journal_path
+        ):
+            rec.record("restore", what="journal", path=self.journal_path)
+        rec.to_jsonl(path)
+        self.journal_path = path
+        return path
+
+    # ------------------------------------------------------------ run
+
+    def _advance(self, pos, vel, count):
+        cfg = self.cfg
+        one = np.float32(1.0)
+        pos = (pos + vel * np.float32(cfg.dt)) % one
+        # float32 `%` can round a tiny negative up to exactly 1.0, which
+        # is outside the periodic domain [0, 1)
+        pos = np.where(pos >= one, pos - one, pos)
+        res = self._rd.redistribute(pos, vel, count=count)
+        return (
+            np.asarray(res.positions),
+            np.asarray(res.fields[0]),
+            np.asarray(res.count, np.int32),
+        )
+
+    def _health_check(self) -> dict:
+        verdict = self.monitor.evaluate()
+        if not self.degraded and self.engine != "planar":
+            for f in verdict["findings"]:
+                if f["rule"] == "fast_path_fallback":
+                    self._degrade(f["reason"])
+                    break
+        return verdict
+
+    def _degrade(self, reason: str) -> None:
+        self.recorder.record(
+            "degrade",
+            **{"from": self.engine, "to": "planar", "reason": reason},
+        )
+        self.engine = "planar"
+        self.degraded = True
+        self._rd = None  # rebuilt with the pinned engine on next step
+
+    def healthz(self) -> Tuple[int, dict]:
+        """The ``/healthz`` contract for the supervisor: read-only rule
+        evaluation, HTTP-style status code (503 on ALERT)."""
+        verdict = self.monitor.evaluate(record=False)
+        return (503 if verdict["status"] == "ALERT" else 200), verdict
+
+    def run(self, max_steps: Optional[int] = None):
+        """Advance up to ``max_steps`` (default: to ``cfg.steps``)."""
+        cfg = self.cfg
+        if self.state is None:
+            self.init_state()
+        end = cfg.steps
+        if max_steps is not None:
+            end = min(end, self.step + int(max_steps))
+        while self.step < end:
+            self._ensure_built()
+            t0 = time.perf_counter()
+            self.faults.before_step(self)
+            self.state = self._advance(*self.state)
+            if cfg.step_sleep:
+                time.sleep(cfg.step_sleep)
+            wall = time.perf_counter() - t0
+            self.step += 1
+            self.monitor.note_step_time(wall)
+            self._wall_ema = (
+                wall if self._wall_ema is None
+                else 0.2 * wall + 0.8 * self._wall_ema
+            )
+            if cfg.watchdog_s and wall > cfg.watchdog_s:
+                raise StallError(
+                    f"step {self.step} took {wall:.3f}s "
+                    f"(> {cfg.watchdog_s:.3f}s watchdog)"
+                )
+            if (
+                cfg.snapshot_every
+                and self.step % cfg.snapshot_every == 0
+            ):
+                path = self.snapshot()
+                self.faults.after_snapshot(self, path)
+                self._health_check()
+            elif cfg.health_every and self.step % cfg.health_every == 0:
+                self._health_check()
+        return self.state
+
+    def close(self) -> None:
+        """Orderly shutdown: commit the in-flight snapshot, resolve the
+        engine's deferred overflow windows, export the final journal."""
+        self.join_snapshot_writer()
+        if self._rd is not None:
+            self._rd.flush_overflow_checks()
+        self.export_journal()
+
+    def abandon(self) -> Optional[str]:
+        """Failure-path teardown: like :meth:`close`, but returns any
+        secondary error as a string for the supervisor to append to the
+        primary failure instead of raising over it."""
+        try:
+            self.close()
+        except Exception as e:
+            return f"teardown after failure also failed: " \
+                   f"{type(e).__name__}: {e}"
+        return None
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _force_cpu_if_requested() -> None:
+    # same dance as scripts/pod_smoke.py: the baked sitecustomize pins
+    # the axon TPU platform, hiding a forced virtual CPU mesh
+    if "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    ) and os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            # too late to repoint the platform flag — only OK if the
+            # backend the run is stuck with is the cpu one we wanted
+            if jax.default_backend() != "cpu":
+                raise
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        prog="service.driver",
+        description="long-running drift->redistribute service loop",
+    )
+    p.add_argument("--grid", default="2,2,2")
+    p.add_argument("--n-local", type=int, default=4096)
+    p.add_argument("--steps", type=int, default=64)
+    p.add_argument("--fill", type=float, default=0.9)
+    p.add_argument("--migration", type=float, default=0.02)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--backend", default="jax", choices=("jax", "numpy"))
+    p.add_argument("--engine", default="auto")
+    p.add_argument("--snapshot-every", type=int, default=0)
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--journal-dir", default=None)
+    p.add_argument("--keep-snapshots", type=int, default=4)
+    p.add_argument("--sync-snapshots", action="store_true")
+    p.add_argument("--watchdog", type=float, default=0.0)
+    p.add_argument("--step-sleep", type=float, default=0.0)
+    p.add_argument(
+        "--no-resume", action="store_true",
+        help="ignore existing snapshots; start from the seeded state",
+    )
+    p.add_argument(
+        "--supervise", action="store_true",
+        help="run under the Supervisor (restore/backoff/circuit breaker)",
+    )
+    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--window-s", type=float, default=300.0)
+    p.add_argument("--backoff-base", type=float, default=0.05)
+    p.add_argument("--backoff-cap", type=float, default=2.0)
+    p.add_argument(
+        "--inject-crash", type=int, default=None, metavar="STEP",
+        help="inject a crash at STEP (-1 = every run: crash-loop)",
+    )
+    p.add_argument(
+        "--hard-crash", action="store_true",
+        help="crash via os._exit (subprocess kill tests) instead of raise",
+    )
+    p.add_argument(
+        "--final-out", default=None,
+        help="write the final state (pos/vel/count/step npz) here",
+    )
+    args = p.parse_args(argv)
+
+    _force_cpu_if_requested()
+
+    cfg = DriverConfig(
+        grid_shape=tuple(int(x) for x in args.grid.split(",")),
+        n_local=args.n_local,
+        fill=args.fill,
+        steps=args.steps,
+        seed=args.seed,
+        migration=args.migration,
+        backend=args.backend,
+        engine=args.engine,
+        snapshot_every=args.snapshot_every,
+        snapshot_dir=args.snapshot_dir,
+        keep_snapshots=args.keep_snapshots,
+        snapshot_async=not args.sync_snapshots,
+        journal_dir=args.journal_dir,
+        watchdog_s=args.watchdog,
+        step_sleep=args.step_sleep,
+    )
+    faults = FaultPlan()
+    if args.inject_crash is not None:
+        from mpi_grid_redistribute_tpu.service.faults import CrashFault
+
+        step = None if args.inject_crash < 0 else args.inject_crash
+        faults.faults.append(CrashFault(step, hard=args.hard_crash))
+
+    if args.supervise:
+        from mpi_grid_redistribute_tpu.service.supervisor import (
+            RestartPolicy,
+            Supervisor,
+        )
+
+        recorder = StepRecorder()
+        sup = Supervisor(
+            lambda: ServiceDriver(cfg, recorder=recorder, faults=faults),
+            policy=RestartPolicy(
+                max_restarts=args.max_restarts,
+                window_s=args.window_s,
+                backoff_base_s=args.backoff_base,
+                backoff_cap_s=args.backoff_cap,
+            ),
+            recorder=recorder,
+        )
+        verdict = sup.run()
+        print(json.dumps(verdict._asdict()), flush=True)
+        if args.final_out and sup.driver is not None and (
+            sup.driver.state is not None
+        ):
+            pos, vel, count = sup.driver.state
+            np.savez(
+                args.final_out, pos=pos, vel=vel, count=count,
+                step=sup.driver.step,
+            )
+        return 0 if verdict.ok else 3
+
+    drv = ServiceDriver(cfg, faults=faults)
+    if not args.no_resume:
+        drv.restore_latest()
+    drv.run()
+    drv.close()
+    if args.final_out:
+        pos, vel, count = drv.state
+        np.savez(
+            args.final_out, pos=pos, vel=vel, count=count, step=drv.step
+        )
+    print(
+        json.dumps(
+            {"ok": True, "step": drv.step,
+             "counts": drv.recorder.counts()}
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

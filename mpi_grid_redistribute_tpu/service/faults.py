@@ -1,0 +1,267 @@
+"""Deterministic fault injection for the service loop (ISSUE 6).
+
+Every failure mode the supervisor claims to survive has an injector
+here, so the claim is a test, not a hope. Injectors are plain objects
+with ``before_step(driver)`` / ``after_snapshot(driver, path)`` hooks
+the :class:`~.driver.ServiceDriver` calls at fixed points; a
+:class:`FaultPlan` is an ordered bag of them. Plans are deterministic:
+an injector fires at an explicit step (or snapshot ordinal), and
+:meth:`FaultPlan.seeded` derives those steps from a seed — the same
+seed always produces the same schedule, so a fault-matrix failure
+reproduces exactly.
+
+Each injection journals a ``fault_injected`` event *before* the damage,
+so the journal always explains what the recovery events that follow are
+recovering from (telemetry/SCHEMA.md).
+
+The five injectors (one per tentpole failure mode):
+
+* :class:`CrashFault` — raise :class:`InjectedCrash` (or hard
+  ``os._exit`` for subprocess kill tests) mid-step; ``step=None``
+  crashes every run — the crash-loop that must trip the supervisor's
+  circuit breaker.
+* :class:`TornSnapshotFault` — corrupt a committed snapshot shard on
+  disk (bit-rot simulation; the atomic publish already rules out torn
+  *writes*), then crash, so the restore path must skip it.
+* :class:`StallFault` — sleep through the driver's watchdog budget; the
+  watchdog turns the stall into a :class:`StallError` failure.
+* :class:`JournalShardLossFault` — delete the driver's exported journal
+  shard; the next export must detect and heal it (journaled
+  ``restore`` with ``what="journal"``).
+* :class:`FallbackFloodFault` — journal synthetic dense-fallback
+  ``fast_path`` events until the ``fast_path_fallback`` health rule
+  fires and the driver degrades ``engine -> planar`` (one-way, no
+  flapping).
+"""
+# gridlint: service-path
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class InjectedCrash(RuntimeError):
+    """A deliberate mid-step process failure from :class:`CrashFault`."""
+
+
+class StallError(RuntimeError):
+    """A step exceeded the driver's watchdog budget (stalled step is a
+    failure, not a wait — the supervisor restarts from snapshot)."""
+
+
+class CrashFault:
+    """Crash at ``step`` (``None`` = every run: the crash-loop case).
+
+    ``hard=True`` exits the process with ``os._exit(exit_code)`` — the
+    subprocess kill path for ``pod_smoke --kill-restore``; the default
+    raises :class:`InjectedCrash` for in-process supervision tests.
+    """
+
+    kind = "crash"
+
+    def __init__(self, step: Optional[int], hard: bool = False,
+                 exit_code: int = 13):
+        self.step = None if step is None else int(step)
+        self.hard = bool(hard)
+        self.exit_code = int(exit_code)
+        self.fired = False
+
+    def before_step(self, driver) -> None:
+        if self.step is not None and (self.fired or driver.step != self.step):
+            return
+        self.fired = True
+        driver.recorder.record(
+            "fault_injected", fault=self.kind, step=driver.step,
+            hard=self.hard,
+        )
+        if self.hard:
+            os._exit(self.exit_code)
+        raise InjectedCrash(f"injected crash at step {driver.step}")
+
+
+class StallFault:
+    """Sleep ``seconds`` inside step ``step`` — longer than the driver's
+    watchdog budget, so the step is *treated as a failure* (the watchdog
+    raises :class:`StallError` after the step completes late)."""
+
+    kind = "stall"
+
+    def __init__(self, step: int, seconds: float):
+        self.step = int(step)
+        self.seconds = float(seconds)
+        self.fired = False
+
+    def before_step(self, driver) -> None:
+        if self.fired or driver.step != self.step:
+            return
+        self.fired = True
+        driver.recorder.record(
+            "fault_injected", fault=self.kind, step=driver.step,
+            seconds=self.seconds,
+        )
+        time.sleep(self.seconds)
+
+
+class TornSnapshotFault:
+    """Corrupt one shard of the ``snapshot_index``-th committed snapshot
+    (0-based), then crash on the next step.
+
+    The atomic publish in ``utils/checkpoint.py`` makes torn *writes*
+    impossible, so this models at-rest corruption (bit rot, partial
+    disk failure) of an already-committed snapshot: the shard file is
+    truncated in place. The supervisor's restore must then skip the
+    corrupt snapshot (checksum mismatch) and fall back to the previous
+    valid one — defaulting to index 1 so a valid index-0 snapshot
+    exists to fall back to.
+    """
+
+    kind = "torn_snapshot"
+
+    def __init__(self, snapshot_index: int = 1, shard: int = 0):
+        self.snapshot_index = int(snapshot_index)
+        self.shard = int(shard)
+        self.fired = False
+        self._seen = 0
+        self._crash_pending = False
+
+    def after_snapshot(self, driver, path: str) -> None:
+        ordinal = self._seen
+        self._seen += 1
+        if self.fired or ordinal != self.snapshot_index:
+            return
+        self.fired = True
+        driver.join_snapshot_writer()  # corrupt the COMMITTED bytes
+        shard_path = os.path.join(path, f"shard_{self.shard:05d}.npz")
+        size = os.path.getsize(shard_path)
+        with open(shard_path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        driver.recorder.record(
+            "fault_injected", fault=self.kind, step=driver.step,
+            path=shard_path,
+        )
+        self._crash_pending = True
+
+    def before_step(self, driver) -> None:
+        if self._crash_pending:
+            self._crash_pending = False
+            raise InjectedCrash(
+                f"injected crash after torn snapshot at step {driver.step}"
+            )
+
+
+class JournalShardLossFault:
+    """Delete the driver's exported journal shard at ``step``. The next
+    journal export must notice the loss and re-export the retained
+    window (journaled as ``restore`` with ``what="journal"``) — shard
+    loss heals, it never silently truncates history."""
+
+    kind = "journal_loss"
+
+    def __init__(self, step: int):
+        self.step = int(step)
+        self.fired = False
+
+    def before_step(self, driver) -> None:
+        if self.fired or driver.step != self.step:
+            return
+        path = driver.journal_path
+        if path is None or not os.path.exists(path):
+            return  # nothing exported yet: keep waiting past self.step
+        self.fired = True
+        driver.recorder.record(
+            "fault_injected", fault=self.kind, step=driver.step, path=path,
+        )
+        os.remove(path)
+
+
+class FallbackFloodFault:
+    """Journal ``steps`` synthetic dense-fallback ``fast_path`` events
+    starting at ``start_step`` — the signature of an undersized
+    ``mover_cap`` (or a workload that stopped being mover-sparse). The
+    ``fast_path_fallback`` health rule must WARN and the driver must
+    degrade ``engine -> planar`` exactly once (journaled ``degrade``),
+    instead of flapping between engines."""
+
+    kind = "fallback_flood"
+
+    def __init__(self, start_step: int, steps: int = 24):
+        self.start_step = int(start_step)
+        self.steps = int(steps)
+        self.fired = False
+
+    def before_step(self, driver) -> None:
+        if not self.start_step <= driver.step < self.start_step + self.steps:
+            return
+        if not self.fired:
+            self.fired = True
+            driver.recorder.record(
+                "fault_injected", fault=self.kind, step=driver.step,
+                steps=self.steps,
+            )
+        driver.recorder.record(
+            "fast_path", step=driver.step, taken=0, movers=0,
+        )
+
+
+class FaultPlan:
+    """An ordered bag of injectors the driver consults at its hooks."""
+
+    def __init__(self, faults: Sequence[object] = ()):
+        self.faults: List[object] = list(faults)
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def before_step(self, driver) -> None:
+        for f in self.faults:
+            hook = getattr(f, "before_step", None)
+            if hook is not None:
+                hook(driver)
+
+    def after_snapshot(self, driver, path: str) -> None:
+        for f in self.faults:
+            hook = getattr(f, "after_snapshot", None)
+            if hook is not None:
+                hook(driver, path)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        steps: int,
+        kinds: Sequence[str] = (
+            "crash", "stall", "torn_snapshot", "journal_loss",
+            "fallback_flood",
+        ),
+        stall_seconds: float = 0.3,
+    ) -> "FaultPlan":
+        """Deterministic schedule: injection steps drawn (without
+        replacement) from ``[1, steps)`` by a seeded generator — the
+        same ``(seed, steps, kinds)`` always yields the same plan."""
+        if steps < 2:
+            raise ValueError(f"steps must be >= 2, got {steps}")
+        rng = np.random.default_rng(seed)
+        picks = rng.choice(
+            np.arange(1, steps), size=min(len(kinds), steps - 1),
+            replace=False,
+        )
+        faults: List[object] = []
+        for kind, at in zip(kinds, picks):
+            at = int(at)
+            if kind == "crash":
+                faults.append(CrashFault(at))
+            elif kind == "stall":
+                faults.append(StallFault(at, stall_seconds))
+            elif kind == "torn_snapshot":
+                faults.append(TornSnapshotFault())
+            elif kind == "journal_loss":
+                faults.append(JournalShardLossFault(at))
+            elif kind == "fallback_flood":
+                faults.append(FallbackFloodFault(at))
+            else:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        return cls(faults)

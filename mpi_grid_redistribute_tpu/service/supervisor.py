@@ -1,0 +1,163 @@
+"""Supervised restart for the service driver (ISSUE 6 tentpole).
+
+The :class:`Supervisor` owns the restart policy the driver itself must
+not know about: it builds a fresh :class:`~.driver.ServiceDriver` per
+attempt from a caller-supplied factory, restores it from the latest
+valid snapshot (:func:`~..utils.checkpoint.load_latest` skips corrupt
+ones), runs it, and decides what a failure means:
+
+* an exception out of ``run()`` (injected crash, watchdog
+  :class:`~.faults.StallError`, snapshot-write error) → restart;
+* a *clean* completion whose ``/healthz`` answers 503 (ALERT) →
+  also a failure — the SLO surface is wired into the restart decision,
+  a green exit with a red health verdict is not success;
+* too many restarts inside a sliding window → the crash-loop circuit
+  breaker trips and the supervisor gives up with an explicit verdict
+  (``gave_up=True``; CLI exit code 3), instead of burning the machine
+  retrying a deterministic failure forever.
+
+Between restarts it sleeps a bounded exponential backoff with seeded
+jitter (deterministic in tests via ``sleep_fn``/``clock`` injection).
+Every decision is journaled as a ``restart`` event (telemetry/SCHEMA.md)
+in the recorder SHARED across attempts — the journal, not the process,
+is the durable record of the incident.
+"""
+# gridlint: service-path
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, NamedTuple, Optional
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.telemetry import StepRecorder
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartPolicy:
+    """Knobs of the restart decision (README "Service mode")."""
+
+    max_restarts: int = 5      # breaker: give up at this many in window
+    window_s: float = 300.0    # sliding window the breaker counts over
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    jitter: float = 0.25       # backoff *= 1 + jitter*U[0,1)
+    seed: int = 0              # jitter stream (deterministic schedules)
+
+    def backoff_s(self, attempt: int, rng: np.random.Generator) -> float:
+        base = min(
+            self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt)
+        )
+        return base * (1.0 + self.jitter * float(rng.random()))
+
+
+class SupervisorVerdict(NamedTuple):
+    """Terminal outcome of a supervised run."""
+
+    ok: bool
+    restarts: int
+    gave_up: bool
+    reason: str        # "" on success; last failure / breaker message
+    step: int          # driver step at exit
+    health: str        # final /healthz status string (OK/WARN/ALERT)
+
+
+class Supervisor:
+    """Run a driver factory to completion through restarts.
+
+    ``driver_factory`` must return a FRESH driver per call, all sharing
+    one recorder (so the journal spans the incident) and, in tests, one
+    fault plan (so already-fired injectors stay fired across restarts).
+    ``sleep_fn``/``clock`` are injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        driver_factory: Callable[[], "ServiceDriver"],
+        policy: Optional[RestartPolicy] = None,
+        recorder: Optional[StepRecorder] = None,
+        sleep_fn: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.driver_factory = driver_factory
+        self.policy = policy if policy is not None else RestartPolicy()
+        self._recorder = recorder
+        self.sleep_fn = sleep_fn
+        self.clock = clock
+        self.driver = None  # last driver instance (final state lives here)
+
+    @property
+    def recorder(self) -> StepRecorder:
+        if self._recorder is None:
+            # adopt the factory's recorder so restart events land in the
+            # same journal as the driver's snapshot/fault events
+            self._recorder = self.driver.recorder if self.driver is not None \
+                else self.driver_factory().recorder
+        return self._recorder
+
+    def run(self) -> SupervisorVerdict:
+        policy = self.policy
+        rng = np.random.default_rng(policy.seed)
+        restart_times: List[float] = []
+        attempt = 0
+        while True:
+            driver = self.driver_factory()
+            self.driver = driver
+            if self._recorder is None:
+                self._recorder = driver.recorder
+            failure: Optional[str] = None
+            try:
+                if not driver.restore_latest():
+                    driver.init_state()
+                driver.run()
+                driver.close()
+            except Exception as e:
+                failure = f"{type(e).__name__}: {e}"
+                note = driver.abandon()
+                if note is not None:
+                    failure = f"{failure} ({note})"
+            if failure is None:
+                code, verdict = driver.healthz()
+                if code == 503:
+                    # a clean exit with an ALERTing health verdict is a
+                    # failure: restart and let recovery clear the alert
+                    reasons = "; ".join(
+                        f["reason"] for f in verdict["findings"]
+                        if f["severity"] == "ALERT"
+                    )
+                    failure = f"healthz 503: {reasons or 'ALERT'}"
+                else:
+                    return SupervisorVerdict(
+                        ok=True, restarts=attempt, gave_up=False,
+                        reason="", step=driver.step,
+                        health=verdict["status"],
+                    )
+            now = self.clock()
+            restart_times = [
+                t for t in restart_times if now - t <= policy.window_s
+            ]
+            if len(restart_times) >= policy.max_restarts:
+                reason = (
+                    f"circuit breaker: {len(restart_times)} restarts in "
+                    f"{policy.window_s:.0f}s window (last: {failure})"
+                )
+                self.recorder.record(
+                    "restart", action="give_up", attempt=attempt,
+                    reason=reason, step=driver.step,
+                )
+                _, verdict = driver.healthz()
+                return SupervisorVerdict(
+                    ok=False, restarts=attempt, gave_up=True,
+                    reason=reason, step=driver.step,
+                    health=verdict["status"],
+                )
+            backoff = policy.backoff_s(attempt, rng)
+            self.recorder.record(
+                "restart", action="restart", attempt=attempt,
+                reason=failure, backoff_s=backoff, step=driver.step,
+            )
+            self.sleep_fn(backoff)
+            restart_times.append(self.clock())
+            attempt += 1

@@ -107,6 +107,7 @@ from mpi_grid_redistribute_tpu.telemetry.health import (  # noqa: F401
     HealthRule,
     default_rules,
     fast_path_fallback,
+    snapshot_staleness,
 )
 from mpi_grid_redistribute_tpu.telemetry.traceview import (  # noqa: F401
     to_chrome_trace,

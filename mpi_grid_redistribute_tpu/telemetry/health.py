@@ -34,10 +34,15 @@ The stock rules (:func:`default_rules`):
   dense planar path on more than ``threshold`` of the last ``window``
   ``fast_path`` events: ``mover_cap`` is undersized (or the workload is
   not mover-sparse) and every step pays guard + dense cost. WARN.
+* ``snapshot_staleness`` — wall time since the last ``snapshot`` event
+  exceeds ``factor`` x its recorded cadence: the service driver's
+  checkpoint writer has stalled or died, so a crash now loses more work
+  than the restart policy budgets for. WARN.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
 from mpi_grid_redistribute_tpu.telemetry.recorder import StepRecorder
@@ -193,6 +198,36 @@ def fast_path_fallback(
     return HealthRule("fast_path_fallback", WARN, fn)
 
 
+def snapshot_staleness(factor: float = 2.0) -> HealthRule:
+    """WARN when the wall time since the last ``snapshot`` event exceeds
+    ``factor`` x the cadence that event recorded (``cadence_s``, the
+    service driver's ``snapshot_every`` x step-time EMA). A stale
+    snapshot means the checkpoint writer is stalled or dead: the state
+    at risk on a crash keeps growing past what the restart policy
+    budgets for. Quiet until a snapshot with a known cadence exists —
+    a run with snapshots off is not evidence of staleness."""
+    if factor <= 0:
+        raise ValueError(f"factor must be > 0, got {factor}")
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        e = rec.last("snapshot")
+        if e is None:
+            return None
+        cadence = float(e.data.get("cadence_s", 0.0))
+        if cadence <= 0.0:
+            return None  # cadence unknown (cold step-time EMA)
+        age = time.time() - e.time
+        if age > factor * cadence:
+            return (
+                f"last snapshot (step {e.data.get('step')}) is "
+                f"{age:.1f}s old, > {factor:.1f}x the {cadence:.1f}s "
+                f"cadence: checkpoint writer stalled or dead"
+            )
+        return None
+
+    return HealthRule("snapshot_staleness", WARN, fn)
+
+
 def default_rules() -> List[HealthRule]:
     return [
         backlog_growth(),
@@ -201,6 +236,7 @@ def default_rules() -> List[HealthRule]:
         imbalance_ratio(),
         step_time_spike(),
         fast_path_fallback(),
+        snapshot_staleness(),
     ]
 
 

@@ -69,6 +69,12 @@ GUARDED_METRICS: Dict[str, str] = {
     # the exchange itself kept its roof-side headroom. Skipped against
     # captures that predate the stress field.
     "stress_bw_util": "higher",
+    # the service soak's sustained throughput with the checkpoint
+    # cadence ON (bench.py "soak" key <- config8_soak): guards the full
+    # service loop — host drift + public-API redistribute + async
+    # snapshot writer — so durability cannot silently get expensive.
+    # Skipped against captures that predate the soak field.
+    "soak_pps": "higher",
 }
 
 # nested fallbacks: a metric missing at the top level of the parsed
@@ -79,6 +85,7 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "exchange_bw_util": ("report", "bw_util"),
     "exchange_bytes_per_sec": ("report", "exchange_bytes_per_sec"),
     "stress_bw_util": ("stress", "bw_util"),
+    "soak_pps": ("soak", "value"),
 }
 
 

@@ -11,17 +11,66 @@ formats:
   * ``save_orbax`` / ``load_orbax`` — thin orbax-checkpoint passthrough for
     users already managing orbax state (kept optional; npz is the default
     because it has zero deps and the state is plain arrays).
+
+Service-mode hardening (ISSUE 6): a snapshot directory is published
+ATOMICALLY — everything is written into a ``<dir>.tmp-<pid>`` sibling and
+renamed into place, so a crash mid-write can never leave a half-visible
+snapshot; the manifest carries a sha256 per shard file, and ``load``
+verifies them, raising :class:`CheckpointCorruptError` (naming the bad
+shard) instead of a raw ``zipfile``/``KeyError`` traceback on torn or
+bit-rotted shards. :func:`load_latest` scans a directory of snapshots
+(the driver's ``step_XXXXXXXX`` layout, or anything containing manifests)
+newest-first and returns the first one that loads clean, counting how
+many invalid ones it had to skip — the supervisor's restore path.
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
 import json
 import os
-from typing import Dict, Optional, Sequence, Tuple
+import shutil
+import zipfile
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 _MANIFEST = "manifest.json"
+_TMP_TAG = ".tmp-"
+_OLD_TAG = ".old-"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot failed to load: torn shard, checksum mismatch, missing
+    file, or an unreadable manifest. ``shard`` names the offending file
+    (``manifest.json`` when the manifest itself is bad)."""
+
+    def __init__(self, directory: str, shard: str, detail: str):
+        self.directory = directory
+        self.shard = shard
+        self.detail = detail
+        super().__init__(
+            f"corrupt checkpoint {directory!r} (shard {shard}): {detail}"
+        )
+
+
+class LatestCheckpoint(NamedTuple):
+    """Result of :func:`load_latest`: the newest snapshot that loaded
+    clean, plus how many newer-but-invalid ones were skipped over."""
+
+    arrays: Dict[str, np.ndarray]
+    manifest: dict
+    path: str
+    skipped: int
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def save(
@@ -32,7 +81,7 @@ def save(
     extra: Optional[dict] = None,
     per_shard: Sequence[str] = ("count",),
 ) -> None:
-    """Write one npz per shard + a manifest.
+    """Write one npz per shard + a manifest, published atomically.
 
     ``arrays`` maps names to global padded arrays whose leading dim divides
     by ``nranks`` (the library's global layout). Names listed in
@@ -40,8 +89,12 @@ def save(
     vectors (one entry per shard, e.g. the ``count`` array); membership is
     by name, never inferred from shape, so a genuine global 1-D array that
     happens to have ``nranks`` rows shards normally.
+
+    The whole snapshot is staged in a ``<directory>.tmp-<pid>`` sibling
+    and renamed into place only once every shard and the manifest (with
+    per-shard sha256 checksums) are on disk — readers either see the
+    previous complete snapshot or the new complete one, never a torn mix.
     """
-    os.makedirs(directory, exist_ok=True)
     per_shard = tuple(per_shard)
     rows = None
     for name, a in arrays.items():
@@ -67,6 +120,17 @@ def save(
             )
     if rows is None:
         raise ValueError("no global arrays to checkpoint")
+
+    directory = directory.rstrip(os.sep)
+    parent = os.path.dirname(directory)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{directory}{_TMP_TAG}{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    checksums: Dict[str, str] = {}
     for rank in range(nranks):
         shard = {}
         for name, a in arrays.items():
@@ -75,19 +139,52 @@ def save(
                 shard[name] = a[rank : rank + 1]
             else:
                 shard[name] = a[rank * rows : (rank + 1) * rows]
-        np.savez_compressed(
-            os.path.join(directory, f"shard_{rank:05d}.npz"), **shard
-        )
+        fname = f"shard_{rank:05d}.npz"
+        np.savez_compressed(os.path.join(tmp, fname), **shard)
+        checksums[fname] = _sha256_file(os.path.join(tmp, fname))
     manifest = {
         "nranks": nranks,
         "rows_per_shard": rows,
         "step": step,
         "names": sorted(arrays.keys()),
         "per_shard": sorted(n for n in per_shard if n in arrays),
+        "checksums": checksums,
         "extra": extra or {},
     }
-    with open(os.path.join(directory, _MANIFEST), "w") as f:
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+
+    # atomic publish: the target either keeps its old complete content or
+    # gains the new complete content — os.rename of the staged dir is the
+    # commit point. An existing target is swung aside first (rename is
+    # atomic; rmtree of the retired copy is not, but at that point it is
+    # no longer the visible snapshot).
+    if os.path.isdir(directory):
+        old = f"{directory}{_OLD_TAG}{os.getpid()}"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.rename(directory, old)
+        os.rename(tmp, directory)
+        shutil.rmtree(old)
+    else:
+        os.rename(tmp, directory)
+
+
+def _read_manifest(directory: str) -> dict:
+    path = os.path.join(directory, _MANIFEST)
+    try:
+        with open(path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(directory, _MANIFEST, str(e)) from e
+    for key in ("nranks", "rows_per_shard", "names"):
+        if key not in manifest:
+            raise CheckpointCorruptError(
+                directory, _MANIFEST, f"missing manifest key {key!r}"
+            )
+    return manifest
 
 
 def load(
@@ -98,25 +195,100 @@ def load(
     ``ranks`` restricts loading to a subset of shards (concatenated in the
     given order) — the resume path for re-decomposing onto a different
     grid: load everything, then :func:`..api.redistribute` once.
+
+    Every shard is checksum-verified against the manifest (when the
+    manifest carries checksums — pre-hardening snapshots without them
+    still load); any torn zip, missing file, missing array, or checksum
+    mismatch raises :class:`CheckpointCorruptError` naming the shard.
     """
-    with open(os.path.join(directory, _MANIFEST)) as f:
-        manifest = json.load(f)
+    manifest = _read_manifest(directory)
     nranks = manifest["nranks"]
+    checksums = manifest.get("checksums", {})
     if ranks is None:
         ranks = range(nranks)
-    parts: Dict[str, list] = {}
+    parts: Dict[str, List[np.ndarray]] = {}
     for rank in ranks:
         if not 0 <= rank < nranks:
             raise ValueError(f"rank {rank} outside checkpoint of {nranks}")
-        with np.load(
-            os.path.join(directory, f"shard_{rank:05d}.npz")
-        ) as z:
-            for name in manifest["names"]:
-                parts.setdefault(name, []).append(z[name])
+        fname = f"shard_{rank:05d}.npz"
+        path = os.path.join(directory, fname)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(directory, fname, str(e)) from e
+        want = checksums.get(fname)
+        if want is not None:
+            got = hashlib.sha256(raw).hexdigest()
+            if got != want:
+                raise CheckpointCorruptError(
+                    directory,
+                    fname,
+                    f"sha256 mismatch: manifest {want[:12]}…, "
+                    f"file {got[:12]}…",
+                )
+        try:
+            with np.load(io.BytesIO(raw)) as z:
+                for name in manifest["names"]:
+                    parts.setdefault(name, []).append(z[name])
+        except (zipfile.BadZipFile, KeyError, OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                directory, fname, f"{type(e).__name__}: {e}"
+            ) from e
     return {
         name: np.concatenate(chunks, axis=0)
         for name, chunks in parts.items()
     }, manifest
+
+
+def list_snapshots(root: str) -> List[str]:
+    """Candidate snapshot directories under ``root``, newest first.
+
+    Any subdirectory not left over from a staged/retired write
+    (``.tmp-``/``.old-`` suffixes) is a candidate — even one with a
+    missing or broken manifest, so :func:`load_latest` can *count* it as
+    skipped instead of silently ignoring a torn newest snapshot. Ordered
+    by manifest ``step`` when readable, falling back to directory mtime.
+    """
+    if not os.path.isdir(root):
+        return []
+    cands = []
+    for name in sorted(os.listdir(root)):
+        if _TMP_TAG in name or _OLD_TAG in name:
+            continue
+        path = os.path.join(root, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            with open(os.path.join(path, _MANIFEST), encoding="utf-8") as f:
+                step = int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            step = -1  # unreadable manifest: sorts oldest, still listed
+        cands.append((step, os.stat(path).st_mtime_ns, name, path))
+    cands.sort(reverse=True)
+    return [c[-1] for c in cands]
+
+
+def load_latest(
+    root: str, ranks: Optional[Sequence[int]] = None
+) -> Optional[LatestCheckpoint]:
+    """Load the newest snapshot under ``root`` that passes validation.
+
+    Invalid snapshots (torn shards, checksum mismatches, broken
+    manifests) are skipped, newest-first, and counted — the supervisor
+    journals that count in its ``restore`` event so a corrupted snapshot
+    is never silently stepped over. Returns ``None`` when no valid
+    snapshot exists.
+    """
+    skipped = 0
+    for path in list_snapshots(root):
+        try:
+            arrays, manifest = load(path, ranks=ranks)
+        except CheckpointCorruptError:
+            skipped += 1
+            continue
+        return LatestCheckpoint(arrays, manifest, path, skipped)
+    return None
 
 
 def save_orbax(path: str, pytree) -> None:

@@ -77,3 +77,25 @@ def test_config5_deposit():
 
     out = config5_deposit.run(n_local=1 << 10, mesh_cells=16)
     assert out["value"] > 0
+
+
+def test_config8_soak(monkeypatch):
+    from mpi_grid_redistribute_tpu.bench import config8_soak
+
+    monkeypatch.setenv("BENCH_SOAK_EVERY", "4")  # short cadence, short run
+    out = config8_soak.run(n_local=512, reps=2)
+    assert out["metric"] == "soak_pps"
+    assert out["value"] > 0
+    assert out["snapshots_written"] >= 1
+    assert np.isfinite(out["snapshot_overhead"])
+    # the crash leg: exactly one supervised restart, and the resumed
+    # trajectory byte-equal to the uninterrupted run (the tier-1 half of
+    # the `make soak` gate; the 2% overhead budget is gated at real
+    # scale by `make soak` / bench-check, not at this smoke size)
+    assert out["restarts"] == 1
+    assert out["bit_identical_resume"] is True
+    # the gate helper agrees with a green capture when overhead passes
+    ok = dict(out, snapshot_overhead=0.0)
+    assert config8_soak._soak_gate(ok) == []
+    bad = dict(out, bit_identical_resume=False)
+    assert config8_soak._soak_gate(bad) != []

@@ -506,6 +506,100 @@ def test_g007_metrics_plane_is_marked_and_clean():
     assert findings == [], findings
 
 
+# ---------------------------------------------------------------- G008
+
+
+def test_g008_fires_on_bare_except_and_swallowed_handler(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "svc.py": """
+    # gridlint: service-path
+
+    def step(run):
+        try:
+            run()
+        except:
+            pass
+
+    def probe(run):
+        try:
+            run()
+        except ValueError:
+            ...
+    """,
+        },
+        rules=["G008"],
+    )
+    assert rules_of(findings) == ["G008"], findings
+    assert len(findings) == 2, findings  # one bare except + one swallow
+    msgs = sorted(f.message for f in findings)
+    assert "bare `except:`" in msgs[0], msgs
+    assert "swallowed exception" in msgs[1], msgs
+
+
+def test_g008_quiet_without_marker_and_on_real_handling(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            # swallows everywhere, but unmarked: out of scope
+            "unmarked.py": """
+    def best_effort(run):
+        try:
+            run()
+        except Exception:
+            pass
+    """,
+            # marked, but every handler does real work: journals the
+            # failure, converts it to a verdict, or narrows + re-raises
+            "svc.py": """
+    # gridlint: service-path
+
+    def supervised(run, recorder):
+        try:
+            run()
+        except Exception as e:
+            recorder.record("restart", reason=str(e))
+
+    def teardown(close):
+        try:
+            close()
+        except OSError as e:
+            return f"teardown failed: {e}"
+        return None
+
+    def narrow(run):
+        try:
+            run()
+        except RuntimeError:
+            if not harmless():
+                raise
+
+    def harmless():
+        return True
+    """,
+        },
+        rules=["G008"],
+    )
+    assert findings == [], findings
+
+
+def test_g008_service_subsystem_is_marked_and_clean():
+    # the real service modules carry the marker (the supervisor must see
+    # every fault) and lint clean — the static half of the never-mask-a-
+    # fault gate (tests/test_service.py's fault matrix is the dynamic
+    # half)
+    from mpi_grid_redistribute_tpu.analysis.rules_service import _MARKER_RE
+
+    svc = os.path.join(PACKAGE, "service")
+    for name in ("driver.py", "supervisor.py", "faults.py"):
+        with open(os.path.join(svc, name), encoding="utf-8") as fh:
+            src = fh.read()
+        assert _MARKER_RE.search(src), f"{name} lost its service-path marker"
+    findings = run_gridlint([svc], root=REPO_ROOT, rules=["G008"])
+    assert findings == [], findings
+
+
 # ------------------------------------------------- suppressions, baseline
 
 

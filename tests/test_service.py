@@ -1,0 +1,329 @@
+"""service/: driver snapshot/restore, supervisor, fault matrix (ISSUE 6).
+
+Everything runs the numpy backend at tiny sizes — the recovery logic
+under test is backend-independent, and the CPU oracle keeps the whole
+fault matrix inside the tier-1 budget. The jax path is covered by the
+config8 soak bench and ``scripts/pod_smoke.py --kill-restore``.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mpi_grid_redistribute_tpu.service import (
+    CrashFault,
+    DriverConfig,
+    FallbackFloodFault,
+    FaultPlan,
+    JournalShardLossFault,
+    RestartPolicy,
+    ServiceDriver,
+    StallFault,
+    Supervisor,
+    TornSnapshotFault,
+)
+from mpi_grid_redistribute_tpu.telemetry import StepRecorder
+from mpi_grid_redistribute_tpu.telemetry import health
+from mpi_grid_redistribute_tpu.utils import checkpoint
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        grid_shape=(2, 2, 2),
+        n_local=256,
+        steps=24,
+        seed=3,
+        backend="numpy",
+        snapshot_every=4,
+        snapshot_dir=str(tmp_path / "snaps"),
+    )
+    base.update(kw)
+    return DriverConfig(**base)
+
+
+def _reference_state(cfg):
+    """The uninterrupted trajectory: same config, snapshots/journal off
+    (neither may influence the state for restarts to be bit-exact)."""
+    ref = ServiceDriver(
+        dataclasses.replace(
+            cfg, snapshot_every=0, snapshot_dir=None, journal_dir=None,
+            watchdog_s=0.0,
+        )
+    )
+    ref.init_state()
+    state = ref.run()
+    ref.close()
+    return state
+
+
+def _assert_bit_identical(a, b):
+    for name, x, y in zip(("pos", "vel", "count"), a, b):
+        assert x.tobytes() == y.tobytes(), f"{name} diverged"
+
+
+# ------------------------------------------------------- driver basics
+
+
+def test_driver_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="snapshot_dir"):
+        ServiceDriver(_cfg(tmp_path, snapshot_dir=None))
+    with pytest.raises(ValueError, match="keep_snapshots"):
+        ServiceDriver(_cfg(tmp_path, keep_snapshots=1))
+
+
+def test_snapshot_restore_bit_identical(tmp_path):
+    cfg = _cfg(tmp_path, keep_snapshots=2)
+    drv = ServiceDriver(cfg)
+    drv.init_state()
+    drv.run(max_steps=10)  # past two snapshot points (steps 4 and 8)
+    drv.close()
+
+    # pruning: only keep_snapshots newest survive on disk
+    snaps = checkpoint.list_snapshots(cfg.snapshot_dir)
+    assert len(snaps) == 2
+
+    resumed = ServiceDriver(cfg)
+    assert resumed.restore_latest() is True
+    assert resumed.step == 8
+    ev = resumed.recorder.last("restore")
+    assert ev.data["what"] == "state" and ev.data["step"] == 8
+    assert ev.data["snapshots_skipped"] == 0
+    resumed.run()  # 8 -> 24 entirely from the restored snapshot
+    resumed.close()
+    _assert_bit_identical(resumed.state, _reference_state(cfg))
+
+
+def test_restore_latest_without_snapshots(tmp_path):
+    drv = ServiceDriver(_cfg(tmp_path, snapshot_every=0, snapshot_dir=None))
+    assert drv.restore_latest() is False
+    drv2 = ServiceDriver(_cfg(tmp_path))  # dir configured but empty
+    assert drv2.restore_latest() is False
+
+
+# ------------------------------------------------------- fault matrix
+
+
+def _supervised(tmp_path, cfg, faults, max_restarts=5):
+    rec = StepRecorder()
+    sup = Supervisor(
+        lambda: ServiceDriver(cfg, recorder=rec, faults=faults),
+        policy=RestartPolicy(
+            max_restarts=max_restarts, backoff_base_s=0.01,
+            backoff_cap_s=0.02,
+        ),
+        recorder=rec,
+        sleep_fn=lambda s: None,
+    )
+    return sup, rec
+
+
+@pytest.mark.parametrize("kind", [
+    "crash", "stall", "torn_snapshot", "journal_loss", "fallback_flood",
+])
+def test_fault_matrix(tmp_path, kind):
+    extra = {}
+    if kind == "crash":
+        fault, restarts = CrashFault(9), 1
+    elif kind == "stall":
+        fault, restarts = StallFault(7, seconds=0.5), 1
+        extra["watchdog_s"] = 0.2
+    elif kind == "torn_snapshot":
+        fault, restarts = TornSnapshotFault(snapshot_index=1), 1
+    elif kind == "journal_loss":
+        fault, restarts = JournalShardLossFault(6), 0
+        extra["journal_dir"] = str(tmp_path / "journal")
+    else:
+        fault, restarts = FallbackFloodFault(start_step=1, steps=24), 0
+
+    cfg = _cfg(tmp_path, **extra)
+    sup, rec = _supervised(tmp_path, cfg, FaultPlan([fault]))
+    verdict = sup.run()
+
+    # every fault mode ends in a healthy, completed service
+    assert verdict.ok is True, verdict
+    assert verdict.gave_up is False
+    assert verdict.restarts == restarts
+    assert verdict.step == cfg.steps
+    counts = rec.counts()
+    assert counts.get("fault_injected") == 1
+    assert counts.get("restart", 0) == restarts
+
+    if kind in ("crash", "stall", "torn_snapshot"):
+        # restarted from a snapshot: a journaled restore, then a resumed
+        # trajectory byte-equal to the uninterrupted run
+        restores = [
+            e for e in rec.events("restore")
+            if e.data.get("what") == "state"
+        ]
+        assert len(restores) == 1
+        _assert_bit_identical(sup.driver.state, _reference_state(cfg))
+        if kind == "torn_snapshot":
+            # the corrupted newest snapshot was skipped, not loaded
+            assert restores[0].data["snapshots_skipped"] >= 1
+            assert restores[0].data["step"] == 4
+    if kind == "stall":
+        assert "StallError" in rec.last("restart").data["reason"]
+    if kind == "journal_loss":
+        # loss detected and healed: shard re-exported with the retained
+        # window, restore(what=journal) journaled, file back on disk
+        heals = [
+            e for e in rec.events("restore")
+            if e.data.get("what") == "journal"
+        ]
+        assert len(heals) == 1
+        assert os.path.exists(sup.driver.journal_path)
+        _assert_bit_identical(sup.driver.state, _reference_state(cfg))
+    if kind == "fallback_flood":
+        # graceful degrade: exactly one engine -> planar transition,
+        # pinned for the rest of the run (never flaps back)
+        degrades = rec.events("degrade")
+        assert len(degrades) == 1
+        assert degrades[0].data["to"] == "planar"
+        assert sup.driver.degraded is True
+        assert sup.driver.engine == "planar"
+        assert verdict.health == "WARN"  # rule still firing, not ALERT
+
+
+def test_crash_loop_trips_circuit_breaker(tmp_path):
+    cfg = _cfg(tmp_path, steps=12)
+    sup, rec = _supervised(
+        tmp_path, cfg, FaultPlan([CrashFault(None)]), max_restarts=3
+    )
+    verdict = sup.run()
+    assert verdict.ok is False
+    assert verdict.gave_up is True
+    assert verdict.restarts == 3
+    assert "circuit breaker" in verdict.reason
+    actions = [e.data["action"] for e in rec.events("restart")]
+    assert actions == ["restart"] * 3 + ["give_up"]
+    # backoff grows (bounded exponential; jitter keeps it monotone here)
+    backoffs = [
+        e.data["backoff_s"] for e in rec.events("restart")
+        if e.data["action"] == "restart"
+    ]
+    assert all(b > 0 for b in backoffs)
+
+
+def test_healthz_alert_forces_restart(tmp_path):
+    # a clean exit with a red /healthz is a failure: the supervisor must
+    # restart, and a deterministic alert must end at the breaker
+    always_red = health.HealthRule(
+        "always_red", health.ALERT, lambda rec: "synthetic alert"
+    )
+    cfg = _cfg(tmp_path, steps=6, snapshot_every=0, snapshot_dir=None)
+    rec = StepRecorder()
+    sup = Supervisor(
+        lambda: ServiceDriver(
+            cfg, recorder=rec,
+            monitor=health.HealthMonitor(rec, rules=[always_red]),
+        ),
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.01),
+        recorder=rec,
+        sleep_fn=lambda s: None,
+    )
+    verdict = sup.run()
+    assert verdict.ok is False and verdict.gave_up is True
+    assert verdict.health == "ALERT"
+    assert "healthz 503" in verdict.reason
+    restart = [
+        e for e in rec.events("restart") if e.data["action"] == "restart"
+    ]
+    assert all("healthz 503" in e.data["reason"] for e in restart)
+
+
+# ------------------------------------------------- plan and health rule
+
+
+def test_seeded_fault_plan_is_deterministic():
+    a = FaultPlan.seeded(7, 30)
+    b = FaultPlan.seeded(7, 30)
+    assert len(a.faults) == 5
+    sig = lambda plan: [
+        (type(f).__name__, getattr(f, "step", getattr(f, "start_step", None)))
+        for f in plan.faults
+    ]
+    assert sig(a) == sig(b)
+    assert sig(FaultPlan.seeded(8, 30)) != sig(a)
+    with pytest.raises(ValueError, match="steps"):
+        FaultPlan.seeded(0, 1)
+
+
+def test_snapshot_staleness_rule():
+    rec = StepRecorder()
+    mon = health.HealthMonitor(rec, rules=[health.snapshot_staleness()])
+    # quiet: no snapshot yet, then cadence unknown (cold EMA), then fresh
+    assert mon.evaluate(record=False)["status"] == "OK"
+    rec.record("snapshot", step=4, cadence_s=0.0)
+    assert mon.evaluate(record=False)["status"] == "OK"
+    rec.record("snapshot", step=8, cadence_s=60.0)
+    assert mon.evaluate(record=False)["status"] == "OK"
+    # a snapshot event far older than 2x its own cadence: writer is dead
+    rec.record_at("snapshot", time.time() - 10.0, step=12, cadence_s=1.0)
+    verdict = mon.evaluate(record=False)
+    assert verdict["status"] == "WARN"
+    (finding,) = verdict["findings"]
+    assert finding["rule"] == "snapshot_staleness"
+    assert "stalled or dead" in finding["reason"]
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _service_cmd(*args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    cmd = [
+        sys.executable, "-m", "mpi_grid_redistribute_tpu.service",
+        "--backend", "numpy", "--grid", "2,2,2", "--n-local", "128",
+    ] + list(args)
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=180
+    )
+
+
+def test_cli_breaker_exit_code():
+    r = _service_cmd(
+        "--steps", "8", "--supervise", "--inject-crash", "-1",
+        "--max-restarts", "2", "--backoff-base", "0.01",
+        "--backoff-cap", "0.02",
+    )
+    assert r.returncode == 3, r.stderr
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is False
+    assert verdict["gave_up"] is True
+    assert verdict["restarts"] == 2
+
+
+def test_cli_hard_crash_then_resume_bit_identical(tmp_path):
+    snaps = str(tmp_path / "snaps")
+    common = ["--steps", "10", "--seed", "5", "--snapshot-every", "3"]
+    # run 1: os._exit(13) at step 7, after committed snapshots at 3 and 6
+    r = _service_cmd(
+        *common, "--snapshot-dir", snaps, "--sync-snapshots",
+        "--inject-crash", "7", "--hard-crash",
+    )
+    assert r.returncode == 13, r.stderr
+    # run 2: resumes from the newest committed snapshot, finishes
+    out = tmp_path / "resumed.npz"
+    r = _service_cmd(
+        *common, "--snapshot-dir", snaps, "--final-out", str(out),
+    )
+    assert r.returncode == 0, r.stderr
+    # reference: uninterrupted run in a fresh snapshot dir
+    ref_out = tmp_path / "ref.npz"
+    r = _service_cmd(
+        *common, "--snapshot-dir", str(tmp_path / "ref_snaps"),
+        "--final-out", str(ref_out),
+    )
+    assert r.returncode == 0, r.stderr
+    got, ref = np.load(out), np.load(ref_out)
+    assert int(got["step"]) == int(ref["step"]) == 10
+    for k in ("pos", "vel", "count"):
+        assert got[k].tobytes() == ref[k].tobytes(), k

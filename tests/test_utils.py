@@ -65,6 +65,99 @@ def test_checkpoint_rejects_ragged(tmp_path, rng):
         )
 
 
+def _save_small(path, rng, R=4, n_local=8, step=0):
+    arrays = {
+        "pos": rng.random((R * n_local, 3)).astype(np.float32),
+        "count": np.full((R,), n_local, dtype=np.int32),
+    }
+    checkpoint.save(str(path), arrays, R, step=step)
+    return arrays
+
+
+def test_checkpoint_truncated_shard_names_the_shard(tmp_path, rng):
+    _save_small(tmp_path / "ck", rng)
+    shard = tmp_path / "ck" / "shard_00002.npz"
+    raw = shard.read_bytes()
+    shard.write_bytes(raw[: len(raw) // 2])  # torn write
+    with pytest.raises(checkpoint.CheckpointCorruptError) as ei:
+        checkpoint.load(str(tmp_path / "ck"))
+    assert ei.value.shard == "shard_00002.npz"
+
+
+def test_checkpoint_bitflip_fails_checksum(tmp_path, rng):
+    _save_small(tmp_path / "ck", rng)
+    shard = tmp_path / "ck" / "shard_00001.npz"
+    raw = bytearray(shard.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # single flipped byte, zip may still open
+    shard.write_bytes(bytes(raw))
+    with pytest.raises(checkpoint.CheckpointCorruptError, match="sha256"):
+        checkpoint.load(str(tmp_path / "ck"))
+
+
+def test_checkpoint_broken_manifest(tmp_path, rng):
+    _save_small(tmp_path / "ck", rng)
+    (tmp_path / "ck" / "manifest.json").write_text("{not json")
+    with pytest.raises(checkpoint.CheckpointCorruptError) as ei:
+        checkpoint.load(str(tmp_path / "ck"))
+    assert ei.value.shard == "manifest.json"
+
+
+def test_load_latest_skips_corrupt_newest(tmp_path, rng):
+    root = tmp_path / "snaps"
+    good = _save_small(root / "step_00000004", rng, step=4)
+    _save_small(root / "step_00000008", rng, step=8)
+    # tear the newest snapshot's first shard: restore must fall back to
+    # step 4 and report exactly one skipped snapshot
+    bad = root / "step_00000008" / "shard_00000.npz"
+    bad.write_bytes(bad.read_bytes()[:16])
+    latest = checkpoint.load_latest(str(root))
+    assert latest is not None
+    assert latest.manifest["step"] == 4
+    assert latest.skipped == 1
+    np.testing.assert_array_equal(latest.arrays["pos"], good["pos"])
+
+
+def test_load_latest_none_when_all_invalid(tmp_path, rng):
+    root = tmp_path / "snaps"
+    _save_small(root / "step_00000002", rng, step=2)
+    (root / "step_00000002" / "manifest.json").unlink()
+    assert checkpoint.load_latest(str(root)) is None
+    assert checkpoint.load_latest(str(tmp_path / "missing")) is None
+
+
+def test_list_snapshots_excludes_staging_dirs(tmp_path, rng):
+    root = tmp_path / "snaps"
+    _save_small(root / "step_00000002", rng, step=2)
+    _save_small(root / "step_00000006", rng, step=6)
+    # leftovers from a crashed mid-write and a retired rename
+    (root / "step_00000009.tmp-123").mkdir()
+    (root / "step_00000004.old-123").mkdir()
+    snaps = checkpoint.list_snapshots(str(root))
+    assert [s.rsplit("/", 1)[-1] for s in snaps] == [
+        "step_00000006", "step_00000002",
+    ]
+
+
+def test_checkpoint_elastic_restore(tmp_path, rng):
+    # the same global state saved at R, 2R, and R/2 shards must all load
+    # back to identical global rows — resume on a different device count
+    R, n_local = 4, 16
+    pos = rng.random((R * n_local, 3)).astype(np.float32)
+    vel = rng.random((R * n_local, 3)).astype(np.float32)
+    for nranks in (R, 2 * R, R // 2):
+        d = tmp_path / f"ck_{nranks}"
+        checkpoint.save(
+            str(d),
+            {"pos": pos, "vel": vel,
+             "count": np.full((nranks,), R * n_local // nranks, np.int32)},
+            nranks,
+        )
+        back, manifest = checkpoint.load(str(d))
+        assert manifest["nranks"] == nranks
+        np.testing.assert_array_equal(back["pos"], pos)
+        np.testing.assert_array_equal(back["vel"], vel)
+
+
 def test_summarize_migrate_and_loss_check():
     from mpi_grid_redistribute_tpu.parallel.migrate import MigrateStats
 
