@@ -3,11 +3,17 @@
 The mover-sparse migrate engine (ISSUE 4) exists to make the per-step
 redistribute cost scale with the MOVERS, not the residents: the fast
 branch may touch the ``[V, mover_cap]`` block and O(V) control arrays,
-never the full ``[K, V*n]`` state beyond one bounded gather/scatter. A
-single ``lax.sort`` or ``jnp.take(..., arange(n))`` slipped into that
-branch silently reverts the engine to O(n log^2 n) while every test
-still passes bit-for-bit — the worst kind of regression, invisible to
-correctness suites and only caught at scale.
+never the full ``[K, V*n]`` state beyond one bounded gather/scatter.
+The count-driven canonical exchange (ISSUE 7) extends the same
+contract to the WIRE: its marked builders (``exchange._sparse_wire``,
+``_neighbor_wire``) may put only ``[K, mover_cap]``-class blocks on
+the ``all_to_all``/``ppermute``, consuming selections (``order``,
+``plan``) made outside the dispatch cond. A single ``lax.sort`` or
+``jnp.take(..., arange(n))`` slipped into a marked region silently
+reverts the engine to O(n log^2 n) — or the wire back to ``R * C``
+columns — while every test still passes bit-for-bit: the worst kind
+of regression, invisible to correctness suites and only caught at
+scale.
 
 A function opts into the contract with a marker comment on the line
 directly above its ``def`` (above decorators, if any)::
@@ -26,7 +32,11 @@ they trace when the branch traces) the rule flags:
 * ``take`` / ``take_along_axis`` whose index argument is built from an
   ``arange`` / ``iota`` — the full-array-gather idiom (a dense
   permutation in disguise). Gathers at plan-shaped index arrays passed
-  in as values are fine: their width is the plan's, not the residents'.
+  in as values are fine: their width is the plan's, not the residents';
+* subscript gathers ``x[..., arange(n), ...]`` — the same dense
+  permutation spelled as advanced indexing (how it reads in the
+  exchange wire builders), caught by the same lexical iota test on the
+  subscript expression.
 
 Like G001's branch-function scan the check is lexical only — a helper
 CALLED from the branch is not scanned. That is deliberate: helpers
@@ -85,6 +95,23 @@ def check_fastpath(project: Project) -> List[Finding]:
             if not _is_marked(fi, mod):
                 continue
             for call in ast.walk(fi.node):
+                if isinstance(call, ast.Subscript):
+                    if _index_has_iota(call.slice):
+                        findings.append(
+                            Finding(
+                                "G006",
+                                mod.relpath,
+                                call.lineno,
+                                call.col_offset,
+                                "subscript with arange/iota-derived "
+                                "indices inside fastpath-engine-marked "
+                                "function — advanced indexing at iota "
+                                "width is a dense gather; index with "
+                                "the mover plan instead",
+                                fi.qualname,
+                            )
+                        )
+                    continue
                 if not isinstance(call, ast.Call):
                     continue
                 tail = last_attr(call_name(call))

@@ -246,6 +246,70 @@ def _build_planar_mesh_call(
 
 
 @functools.lru_cache(maxsize=64)
+def _build_count_driven_vranks_call(
+    domain: Domain, grid: ProcessGrid, cap: int, out_cap: int,
+    mover_cap: int, eng: str, specs, edges=None,
+):
+    """One jitted program: boundary fuse -> count-driven (sparse/neighbor)
+    vrank exchange -> boundary unfuse (single dispatch per call)."""
+    V = grid.nranks
+    builder = (
+        exchange.vrank_redistribute_sparse_fn
+        if eng == "sparse"
+        else exchange.vrank_redistribute_neighbor_fn
+    )
+    engine = builder(
+        domain, grid, cap, out_cap, mover_cap, domain.ndim, edges=edges
+    )
+
+    def call(positions, count, *fields):
+        n_local = positions.shape[0] // V
+        fused = _fuse_planar(positions, fields, V, n_local, specs,
+                             stacked=True)
+        out, new_count, stats = engine(fused, count)
+        pos_out, fields_out = _unfuse_planar(out, specs, V, out_cap,
+                                             stacked=True)
+        return pos_out, new_count, fields_out, stats
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_count_driven_mesh_call(
+    mesh, domain: Domain, grid: ProcessGrid, cap: int, out_cap: int,
+    mover_cap: int, eng: str, specs, edges=None,
+):
+    """One jitted program: boundary fuse -> shard_map count-driven
+    (sparse/neighbor) exchange -> boundary unfuse."""
+    R = grid.nranks
+    sharded = exchange.shard_redistribute_count_driven_sharded(
+        mesh, domain, grid, cap, out_cap, mover_cap, domain.ndim,
+        edges=edges, engine=eng,
+    )
+
+    def call(positions, count, *fields):
+        n_local = positions.shape[0] // R
+        fused = _fuse_planar(positions, fields, R, n_local, specs,
+                             stacked=False)
+        out, new_count, stats = sharded(fused, count)
+        pos_out, fields_out = _unfuse_planar(out, specs, R, out_cap,
+                                             stacked=False)
+        return pos_out, new_count, fields_out, stats
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
+def _neighbor_active_offsets(grid: ProcessGrid, periodic) -> int:
+    """Number of active stencil links of ``grid`` — the neighbor engine's
+    per-shard wire is ``n_active * mover_cap`` columns (vs ``R * cap``
+    dense)."""
+    return sum(
+        1 for p in mesh_lib.neighbor_perms(grid, tuple(periodic)) if p
+    )
+
+
+@functools.lru_cache(maxsize=64)
 def _build_halo_planar_vranks_call(
     domain: Domain, grid: ProcessGrid, widths, pc: int, gc: int, specs
 ):
@@ -356,18 +420,40 @@ class GridRedistribute:
           ``utils.stats.check_no_loss``.
       check_every: cadence (in calls) of the deferred overflow check once
         ``'grow'`` has calibrated (default 16).
-      engine: ``'auto'`` (default), ``'planar'`` or ``'rowmajor'`` — which
-        canonical exchange carries the payload on the jax backend.
+      engine: ``'auto'`` (default), ``'planar'``, ``'sparse'``,
+        ``'neighbor'`` or ``'rowmajor'`` — which canonical exchange
+        carries the payload on the jax backend.
         ``'planar'`` runs the component-major ``[K, n]`` engines
         (payload-carrying-sort compaction; 2.2x the row-major engine at
         4.2M rows — BENCH_CONFIGS.md config 1): no narrow-minor ``[n, 3]``
         buffer exists anywhere, avoiding TPU's T(8,128) tiled-layout
         padding (42.7x for ``[n, 3]``). It requires every array to be
-        32-bit (fields ride bitcast to float32 rows). ``'auto'`` picks
-        planar when eligible and falls back to row-major otherwise;
+        32-bit (fields ride bitcast to float32 rows).
+        ``'sparse'`` is the COUNT-DRIVEN planar engine: the exchange
+        pool shrinks from ``[K, R*C]`` to ``[K, R*mover_cap]``, so wire
+        cost scales with the movers rather than the capacity
+        provisioning; ``'neighbor'`` additionally replaces the dense
+        ``all_to_all`` with a static 3x3x3-stencil ``lax.ppermute``
+        shift schedule (<= 26 neighbor blocks). Both carry planar's
+        32-bit requirement, guard every step with a globally-agreed
+        residence predicate, and fall back to the dense planar pool
+        bit-identically when any shard's movers overflow ``mover_cap``
+        (surfaced in ``stats.fallback``, billed at dense width in
+        ``report()``'s wire model).
+        ``'auto'`` picks the count-driven sparse engine on multi-device
+        meshes, planar on one device (no wire to shrink), and falls back
+        to row-major when the payload is not 32-bit;
         ``'rowmajor'`` forces the round-2 layout (kept for comparison and
-        for non-32-bit payloads). Both produce bit-identical results —
-        same routing, same Alltoallv receive order, oracle-tested.
+        for non-32-bit payloads). All produce bit-identical results —
+        same routing, same Alltoallv receive order, oracle-tested. Every
+        routing decision is journaled as ``engine_resolved``.
+      mover_cap: per-destination column count of the count-driven wire
+        block (pow2-bucketed, never shrinks). ``None`` derives
+        ``capacity // 8`` on first use; measured ``needed_capacity``
+        peaks ratchet it (journaled as ``mover_cap_grow``), and a block
+        grown to >= ``capacity`` degrades the instance to the planar
+        engine (journaled — the count-driven pool would be no smaller
+        than dense).
       edges: optional :class:`~.domain.GridEdges` — NON-UNIFORM per-axis
         subdomain boundaries (the reference family's ``np.digitize`` /
         searchsorted-on-edges variant, SURVEY.md C1/C2). Ownership,
@@ -393,6 +479,7 @@ class GridRedistribute:
         on_overflow: str = "grow",
         check_every: int = 16,
         engine: str = "auto",
+        mover_cap: Optional[int] = None,
         edges=None,
     ):
         self.domain = _as_domain(domain, lo, hi, periodic)
@@ -429,6 +516,24 @@ class GridRedistribute:
                 f"engine must be one of {exchange.ENGINES}, got {engine!r}"
             )
         self.engine = engine
+        # Count-driven wire block (sparse/neighbor canonical engines):
+        # pow2-bucketed like the dense capacity, never shrinks, grows from
+        # the measured `needed_capacity` (the smallest block that would
+        # have kept the fast branch). None = derive from cap on first use.
+        if mover_cap is not None and int(mover_cap) < 1:
+            raise ValueError(f"mover_cap must be >= 1, got {mover_cap}")
+        self._mover_cap = (
+            None if mover_cap is None else _next_pow2(int(mover_cap))
+        )
+        # (requested engine, vranks, planar_ok, n_devices) of the last
+        # resolve — engine_resolved is journaled only when this changes,
+        # not once per call
+        self._last_resolution = None
+        # scheduled-wire model of the last dispatch: engine name,
+        # per-shard wire columns, dense-pool columns, shard count — feeds
+        # the `wire_bytes` journal field and report()'s
+        # wire_bytes_per_step
+        self._last_wire = None
         # deferred-check state for 'grow' (see class docstring): number of
         # consecutive clean synchronous checks, calls since the last
         # deferred check was scheduled, the pending async-copied counters,
@@ -508,6 +613,38 @@ class GridRedistribute:
         out_cap = n_local if self.out_capacity is None else self.out_capacity
         return cap, out_cap
 
+    def _mover_cap_for(self, cap: int) -> int:
+        """Per-destination wire block of the count-driven engines. First
+        use derives it from the dense capacity (cap/8, pow2-bucketed —
+        the ~10% steady-drift operating point of BENCH_CONFIGS.md
+        config 4); after that it only ever grows via
+        :meth:`_maybe_grow_mover_cap`, so recompiles track pow2 bucket
+        crossings exactly like the dense capacities."""
+        if self._mover_cap is None:
+            self._mover_cap = _next_pow2(max(1, cap // 8))
+        return self._mover_cap
+
+    def _maybe_grow_mover_cap(self, needed: int) -> None:
+        """Grow the wire block from measured `needed_capacity` (the
+        per-destination peak — exactly the smallest block that would
+        have kept the count-driven fast branch). The in-graph fallback
+        already delivered bit-identical output for the overflowing
+        call, so this only re-arms the fast path for the NEXT call; no
+        re-run needed. Journals `mover_cap_grow` like MoverCapacity."""
+        if self._mover_cap is None or needed <= self._mover_cap:
+            return
+        wire = self._last_wire
+        if wire is None or wire.get("engine") not in ("sparse", "neighbor"):
+            return  # dense engines don't consume the wire block
+        old = self._mover_cap
+        self._mover_cap = _next_pow2(int(needed))
+        self.telemetry.record(
+            "mover_cap_grow",
+            old=old,
+            new=self._mover_cap,
+            peak_movers=int(needed),
+        )
+
     def _check_inputs(self, pos, fields, count):
         R = self.nranks
         # Both backends bin at the same precision: JAX canonicalizes float64
@@ -584,24 +721,89 @@ class GridRedistribute:
                 exchange.RedistributeStats(**stats),
             )
         specs = None
-        if self.engine in ("auto", "planar", "sparse"):
+        if self.engine in ("auto", "planar", "sparse", "neighbor"):
             specs = _planar_specs(positions, fields)
-            if specs is None and self.engine in ("planar", "sparse"):
+            if specs is None and self.engine in (
+                "planar", "sparse", "neighbor"
+            ):
                 raise TypeError(
                     f"engine={self.engine!r} requires 32-bit positions and "
                     "fields (they ride bitcast to float32 rows); cast or "
                     "use engine='auto'/'rowmajor'"
                 )
         # ONE dispatch rule, shared with the migrate loop
-        # (exchange.resolve_engine). 'sparse' resolves to the planar
-        # canonical engine here: the canonical output contract (MPI
-        # Alltoallv receive order) re-packs every resident row each call,
-        # so the O(movers) fast path only exists on the resident-slot
-        # migrate loop (models.nbody.make_migrate_loop + MoverCapacity).
+        # (exchange.resolve_engine): multi-device 'auto' routes to the
+        # count-driven sparse engine (wire cost scales with movers); the
+        # dense pool is reachable only via explicit engine='planar' or
+        # the in-graph overflow fallback. The decision is journaled as
+        # engine_resolved whenever the routing inputs change.
+        n_dev = 1 if self._vranks else int(self.mesh.devices.size)
+        res_key = (self.engine, self._vranks, specs is not None, n_dev)
+        rec = None
+        if res_key != self._last_resolution:
+            self._last_resolution = res_key
+            rec = self.telemetry
         resolved = exchange.resolve_engine(
-            self.engine, vranks=self._vranks,
-            planar_ok=specs is not None, canonical=True,
+            self.engine, vranks=self._vranks, n_devices=n_dev,
+            planar_ok=specs is not None, canonical=True, recorder=rec,
         )
+        R = self.nranks
+        dense_cols = R * cap
+        if resolved in ("sparse", "neighbor") and specs is not None:
+            B = self._mover_cap_for(cap)
+            if B >= cap:
+                # the grown mover block reached the dense pool size: the
+                # count-driven engine would be a no-op wrapper, run planar
+                if rec is None and self._last_wire is not None and (
+                    self._last_wire.get("engine") != "planar"
+                ):
+                    self.telemetry.record(
+                        "engine_resolved",
+                        requested=self.engine,
+                        resolved="planar",
+                        reason=(
+                            f"{resolved}: mover_cap {B} >= capacity "
+                            f"{cap}, count-driven pool no smaller than "
+                            f"dense"
+                        ),
+                        canonical=True,
+                    )
+                resolved = "planar"
+            else:
+                if resolved == "neighbor":
+                    engine_cols = B * _neighbor_active_offsets(
+                        self.grid, tuple(self.domain.periodic)
+                    )
+                else:
+                    engine_cols = R * B
+                self._last_wire = {
+                    "engine": resolved,
+                    "engine_cols": engine_cols,
+                    "dense_cols": dense_cols,
+                    "shards": R,
+                }
+                if self._vranks:
+                    fn = _build_count_driven_vranks_call(
+                        self.domain, self.grid, cap, out_cap, B, resolved,
+                        specs, edges=self.edges,
+                    )
+                else:
+                    fn = _build_count_driven_mesh_call(
+                        self.mesh, self.domain, self.grid, cap, out_cap,
+                        B, resolved, specs, edges=self.edges,
+                    )
+                pos_out, new_count, fields_out, stats = fn(
+                    positions, count, *fields
+                )
+                return RedistributeResult(
+                    pos_out, fields_out, new_count, stats
+                )
+        self._last_wire = {
+            "engine": resolved,
+            "engine_cols": dense_cols,
+            "dense_cols": dense_cols,
+            "shards": R,
+        }
         if resolved == "planar" and specs is not None:
             # The planar [K, n] engines: the repo's fastest canonical path
             # (BENCH_CONFIGS.md config 1), bit-identical to the row-major
@@ -667,12 +869,23 @@ class GridRedistribute:
             cap, out_cap = self._capacities(n_local)
             result = self._run_once(positions, fields, count, cap, out_cap)
             self._last_stats = result.stats
+            wire = self._last_wire or {}
+            # scheduled wire bytes of this call's exchange collective
+            # (static pool width x row bytes x shards) — what actually
+            # crossed the interconnect, independent of occupancy
+            wire_bytes = (
+                wire.get("engine_cols", 0)
+                * (self._last_row_bytes or 0)
+                * wire.get("shards", 0)
+            )
             self.telemetry.record(
                 "redistribute",
                 call=self._call_index,
                 n_local=n_local,
                 capacity=cap,
                 out_capacity=out_cap,
+                engine=wire.get("engine", self.engine),
+                wire_bytes=wire_bytes,
             )
             if self.on_overflow == "ignore":
                 return result  # async preserved: no host sync on stats
@@ -703,6 +916,9 @@ class GridRedistribute:
             if not dropped_send and not dropped_recv:
                 if self.on_overflow == "grow":
                     self._clean_checks += 1
+                    self._maybe_grow_mover_cap(
+                        int(np.asarray(result.stats.needed_capacity).max())
+                    )
                 return result
             self._clean_checks = 0
             if self.on_overflow == "raise":
@@ -714,6 +930,7 @@ class GridRedistribute:
             # grow: size the rebuild from the measured need, bucketed to
             # powers of two so recompiles track bucket crossings only
             needed = int(np.asarray(result.stats.needed_capacity).max())
+            self._maybe_grow_mover_cap(needed)
             needed_out = int(
                 (
                     np.asarray(result.count)
@@ -1003,6 +1220,10 @@ class GridRedistribute:
         needed_out = int(np.asarray(counters["needed_out"]))
         self._pending_check = None
         self._resolved_through = max(self._resolved_through, call_idx)
+        # re-arm the count-driven fast branch from the window's peak
+        # per-destination need (covers the whole window: the cumulative
+        # counters fold every call's needed_capacity)
+        self._maybe_grow_mover_cap(needed)
         dropped_send = total_send - self._seen_send
         dropped_recv = total_recv - self._seen_recv
         if not dropped_send and not dropped_recv:
@@ -1152,6 +1373,7 @@ class GridRedistribute:
                 "report() needs at least one redistribute() call"
             )
         domain, n_chips = self._exchange_topology()
+        wire = self._last_wire or {}
         out = report_lib.exchange_report(
             self._last_stats,
             self._last_row_bytes,
@@ -1159,7 +1381,11 @@ class GridRedistribute:
             domain=domain,
             n_chips=n_chips,
             recorder=self.telemetry,
+            engine_wire_cols=wire.get("engine_cols"),
+            dense_wire_cols=wire.get("dense_cols"),
+            wire_shards=wire.get("shards"),
         )
+        out["engine"] = wire.get("engine", self.engine)
         out["calls"] = self._call_index
         out["capacity"] = self.capacity
         out["out_capacity"] = self.out_capacity

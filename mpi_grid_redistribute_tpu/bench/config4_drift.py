@@ -16,6 +16,89 @@ from mpi_grid_redistribute_tpu.bench import common
 from mpi_grid_redistribute_tpu.utils import profiling
 
 
+def canonical_wire_capture(
+    grid_shape, migration: float, n_local: int = 1 << 12
+) -> dict:
+    """Measure the count-driven canonical exchange's scheduled wire cost.
+
+    The drift loop above times the MIGRATE engine (per-step compute
+    scales with movers since ISSUE 4); this companion capture runs the
+    same workload shape through the public canonical entry point so the
+    ISSUE 7 wire model lands in the bench JSON: ``wire_bytes_per_step``
+    (pool width x row bytes x shards actually scheduled) next to
+    ``dense_wire_bytes_per_step`` (the old ``[K, R*C]`` schedule).
+    ``regress.py`` guards the ratio's numerator LOWER under
+    ``("report", "wire_bytes_per_step")`` — auto-armed, skipped against
+    histories that predate the field.
+
+    ``auto`` resolves to the count-driven sparse engine whenever one
+    rank rides one device (a CPU mesh or a pod slice); the single-chip
+    vrank build needs the explicit opt-in (``auto`` keeps canonical
+    vrank exchanges on the dense planar engine by design, see
+    ``exchange.resolve_engine``), so pass ``"sparse"`` there. The mover
+    block is sized from the migration fraction with the same 1.5x
+    headroom as ``drift_sizing`` — overflow would fall back dense
+    bit-identically and bill the step at dense width, so an undersized
+    block shows up IN the guarded metric, not as a wrong answer.
+    """
+    import jax
+
+    from mpi_grid_redistribute_tpu import api
+
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    engine = "auto" if len(jax.devices()) >= R else "sparse"
+    m = max(1, int(round(migration * n_local)))
+    rng = np.random.default_rng(7)
+    pos = np.empty((R * n_local, 3), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        sl = slice(r * n_local, (r + 1) * n_local)
+        for a in range(3):
+            w = 1.0 / grid_shape[a]
+            pos[sl, a] = (cell[a] + rng.random(n_local)) * w
+        # exactly m movers per rank, spread over the six face neighbors
+        # round-robin — the drift workload's pattern; what sizes the
+        # block is the PER-DESTINATION peak, not the total mover count
+        for i in range(m):
+            axis = (i % 6) // 2
+            sign = 1.0 if i % 2 == 0 else -1.0
+            j = r * n_local + i
+            pos[j, axis] = np.mod(
+                pos[j, axis] + sign / grid_shape[axis], 1.0
+            )
+    ids = np.arange(R * n_local, dtype=np.int32)
+    # size the mover block from the measured per-destination peak with
+    # drift_sizing's 1.5x headroom (the constructor pow2-buckets it) —
+    # on small grids opposite faces can be the SAME periodic neighbor,
+    # so count real destination cells rather than assuming m/6
+    shape = np.asarray(grid_shape)
+    cells = np.floor(pos * shape).astype(np.int64) % shape
+    flat = (cells[:, 0] * shape[1] + cells[:, 1]) * shape[2] + cells[:, 2]
+    peak = 0
+    for r in range(R):
+        c = grid.cell_of_rank(r)
+        home = (c[0] * shape[1] + c[1]) * shape[2] + c[2]
+        away = flat[r * n_local:(r + 1) * n_local]
+        away = away[away != home]
+        if away.size:
+            peak = max(peak, int(np.bincount(away).max()))
+    rd = api.GridRedistribute(
+        grid=grid_shape, lo=(0.0,) * 3, hi=(1.0,) * 3,
+        periodic=(True,) * 3, engine=engine,
+        mover_cap=max(2, int(peak * 1.5)),
+    )
+    rd.redistribute(pos, ids)
+    rep = rd.report()
+    return {
+        k: rep[k]
+        for k in (
+            "engine", "wire_bytes_per_step", "dense_wire_bytes_per_step"
+        )
+        if k in rep
+    }
+
+
 def run(
     n_local: int = None,
     migration: float = 0.02,
@@ -77,6 +160,16 @@ def run(
         _out[3], 4 * (2 * 3 + 1), step_seconds=per_step,
         domain="ici" if n_chips > 1 else "hbm", n_chips=n_chips,
     )
+    if not bias:
+        # ISSUE 7: count-driven canonical WIRE capture at the same
+        # migration fraction — wire_bytes_per_step lands under "report"
+        # where regress.py's auto-armed LOWER gate reads it
+        wire = canonical_wire_capture(grid_shape, migration)
+        report["wire_engine"] = wire.get("engine")
+        report["wire_bytes_per_step"] = wire.get("wire_bytes_per_step")
+        report["dense_wire_bytes_per_step"] = wire.get(
+            "dense_wire_bytes_per_step"
+        )
     # grid observatory: journal the stats we already read, evaluate the
     # health rules, and ship the verdict alongside the metric — on the
     # default balanced workload this must stay OK; under BENCH_DRIFT_BIAS

@@ -62,8 +62,10 @@ class DriftConfig:
     # migrate-loop engine selection (parallel.exchange.resolve_engine):
     # "auto" picks the mover-sparse fast path when eligible (vgrid on a
     # single device — see shard_migrate_vranks_fn), "sparse" asks for it
-    # explicitly (silently dense when ineligible), "planar" forces the
-    # dense engine.
+    # explicitly (degrades to the dense planar step on cross-device
+    # meshes — journaled as engine_resolved when a recorder is wired),
+    # "planar" forces the dense engine. The canonical-only engines
+    # ("rowmajor", "neighbor") are rejected here.
     engine: str = "auto"
     # static mover-block width for the sparse fast path (rows a vrank
     # may send per step through the O(movers) branch; None -> the
@@ -105,9 +107,11 @@ def make_drift_step(cfg: DriftConfig, mesh: Mesh):
         spec,
         spec,
         spec,
-        exchange.RedistributeStats(
-            *([spec] * len(exchange.RedistributeStats._fields))
-        ),
+        # 5 explicit specs: the rowmajor engine carries no `fallback`
+        # trace, so that leaf stays at its None default (empty pytree
+        # node — a 6th spec here would demand a leaf the engine never
+        # produces)
+        exchange.RedistributeStats(spec, spec, spec, spec, spec),
     )
     if dep_fn is not None:
         out_specs = out_specs + (deposit_lib.deposit_out_spec(cfg.domain, cfg.grid),)

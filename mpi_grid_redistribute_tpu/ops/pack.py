@@ -256,12 +256,41 @@ def planar_compact_with_self(
     R = recv_counts.shape[0]
     C = pool.shape[1] // R
     invalid, source_key = pool_source_keys(recv_counts, self_mask, me, C)
-    source_key = jnp.where(invalid, R, source_key)
     values = jnp.concatenate([pool, local], axis=1)  # [K, R*C + n]
+    new_full = jnp.sum(recv_counts) + jnp.sum(self_mask.astype(jnp.int32))
+    return planar_compact_keys(
+        values, invalid, source_key, R, new_full, out_capacity
+    )
+
+
+def planar_compact_keys(
+    values: jax.Array,
+    invalid: jax.Array,
+    source_key: jax.Array,
+    n_sources: int,
+    new_full: jax.Array,
+    out_capacity: int,
+):
+    """Key-generic tail of :func:`planar_compact_with_self`: compact the
+    ``[K, m]`` column pool ``values`` by the caller's Alltoallv-order keys.
+
+    The count-driven and neighbor wire schedules receive the same rows as
+    the dense pool but at different column addresses (``[R*B]`` blocks,
+    per-offset stencil blocks); the compaction ordering — source-major,
+    stable within source via the column iota — only depends on ``(invalid,
+    source_key)``, so sharing this tail is what makes those engines
+    bit-identical to the dense one: any key construction that marks the
+    same rows valid with the same sources yields byte-identical output.
+
+    ``new_full`` is the caller-computed valid total (garbage columns sort
+    last and are masked); ``n_sources`` is the sentinel written over
+    invalid keys (must exceed every valid source).
+    """
+    source_key = jnp.where(invalid, n_sources, source_key)
     m = values.shape[1]
     iota = jnp.arange(m, dtype=jnp.int32)
     bM = max(1, (m - 1).bit_length())
-    if R + 1 <= (1 << (31 - bM)):
+    if n_sources + 1 <= (1 << (31 - bM)):
         # PACKED single key: ``(source_key << bM) | iota`` is unique and
         # orders exactly like the (source_key, iota) pair, so one int32
         # operand replaces two — 1/(K+2) fewer bytes through the sort
@@ -285,7 +314,6 @@ def planar_compact_with_self(
         )
     else:
         payload = payload[:, :out_capacity]
-    new_full = jnp.sum(recv_counts) + jnp.sum(self_mask.astype(jnp.int32))
     dropped = jnp.maximum(new_full - out_capacity, 0)
     new_count = jnp.minimum(new_full, out_capacity)
     col_valid = jnp.arange(out_capacity, dtype=jnp.int32) < new_count

@@ -22,6 +22,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from mpi_grid_redistribute_tpu.compat import shard_map
@@ -36,7 +37,7 @@ from mpi_grid_redistribute_tpu.ops import binning, pack
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
-ENGINES = ("auto", "planar", "rowmajor", "sparse")
+ENGINES = ("auto", "planar", "rowmajor", "sparse", "neighbor")
 
 
 def resolve_engine(
@@ -46,26 +47,35 @@ def resolve_engine(
     n_devices: int = 1,
     planar_ok: bool = True,
     canonical: bool = False,
+    recorder=None,
 ) -> str:
     """Resolve a user-facing engine name to a concrete engine — the ONE
     dispatch rule shared by :class:`..api.Redistributer` (canonical
     exchange) and :func:`..models.nbody.make_migrate_loop` (resident-slot
     migrate loop), so the two surfaces cannot drift.
 
-    Canonical exchange (``canonical=True``) returns ``"planar"`` or
-    ``"rowmajor"``: ``"auto"`` picks planar when the payload qualifies
-    (``planar_ok`` — 32-bit fields that ride bitcast); ``"sparse"``
-    resolves to planar because the canonical output contract (MPI
-    Alltoallv receive order) forces a full re-pack of every resident row
-    each call — an O(movers) step cannot exist there.
+    Canonical exchange (``canonical=True``): ``"auto"`` picks the
+    count-driven ``"sparse"`` engine on multi-device meshes (wire cost
+    scales with movers — the paper's Alltoallv rationale) and
+    ``"planar"`` on one device (no wire to shrink), degrading to
+    ``"rowmajor"`` when the payload does not qualify for planar
+    transport (``planar_ok`` — 32-bit fields that ride bitcast). The
+    dense pool is reachable only via explicit ``engine="planar"`` or
+    the sparse/neighbor engines' in-graph overflow fallback.
+    ``"sparse"``/``"neighbor"`` are honored as asked (the neighbor
+    engine is the static 3x3x3-stencil ``ppermute`` schedule).
 
     Migrate loop (``canonical=False``) returns ``"sparse"`` or
     ``"planar"``: ``"auto"``/``"sparse"`` pick the mover-sparse fast
     path exactly when the step is a single-device vrank step (``vranks``
     and ``n_devices == 1`` — see
     :func:`..parallel.migrate.shard_migrate_vranks_fn` for why
-    cross-device steps stay dense); ``"rowmajor"`` has no migrate-loop
-    meaning and raises.
+    cross-device steps stay dense); ``"rowmajor"`` and ``"neighbor"``
+    have no migrate-loop meaning and raise.
+
+    ``recorder`` (a :class:`..telemetry.StepRecorder`) journals the
+    decision as an ``engine_resolved`` event — chosen engine plus the
+    reason, including any degradation — so silent routing is observable.
     """
     if engine not in ENGINES:
         raise ValueError(
@@ -73,21 +83,49 @@ def resolve_engine(
         )
     if canonical:
         if engine == "rowmajor":
-            return "rowmajor"
-        # "auto"/"planar"/"sparse" -> planar when the payload qualifies;
-        # "auto" falls back to rowmajor otherwise ("planar" is an
-        # explicit ask — the caller surfaces the typed payload error)
-        if engine == "auto" and not planar_ok:
-            return "rowmajor"
-        return "planar"
-    if engine == "rowmajor":
-        raise ValueError(
-            "engine='rowmajor' is a canonical-exchange engine; the "
-            "migrate loop accepts 'auto', 'sparse' or 'planar'"
+            resolved, reason = "rowmajor", "explicit rowmajor"
+        elif engine == "planar":
+            resolved, reason = "planar", "explicit planar (dense pool)"
+        elif engine == "neighbor":
+            resolved, reason = "neighbor", "explicit neighbor stencil"
+        elif engine == "sparse":
+            resolved, reason = "sparse", "explicit count-driven sparse"
+        elif not planar_ok:
+            resolved, reason = (
+                "rowmajor", "auto: payload not planar-eligible"
+            )
+        elif n_devices > 1:
+            resolved, reason = (
+                "sparse", "auto: multi-device -> count-driven wire"
+            )
+        else:
+            resolved, reason = (
+                "planar", "auto: single device, no wire to shrink"
+            )
+    else:
+        if engine in ("rowmajor", "neighbor"):
+            raise ValueError(
+                f"engine={engine!r} is a canonical-exchange engine; the "
+                "migrate loop accepts 'auto', 'sparse' or 'planar'"
+            )
+        if engine in ("auto", "sparse") and vranks and n_devices == 1:
+            resolved, reason = "sparse", "migrate: single-device vranks"
+        elif engine == "sparse":
+            resolved, reason = (
+                "planar",
+                "sparse -> planar: cross-device migrate steps stay dense",
+            )
+        else:
+            resolved, reason = "planar", "migrate: dense planar step"
+    if recorder is not None:
+        recorder.record(
+            "engine_resolved",
+            requested=engine,
+            resolved=resolved,
+            reason=reason,
+            canonical=bool(canonical),
         )
-    if engine in ("auto", "sparse") and vranks and n_devices == 1:
-        return "sparse"
-    return "planar"
+    return resolved
 
 
 class RedistributeStats(NamedTuple):
@@ -99,13 +137,24 @@ class RedistributeStats(NamedTuple):
     ``needed_capacity`` is the *measured* per-rank max unclipped remote
     per-destination count — the smallest per-pair ``capacity`` that would
     have sent everything (SURVEY.md §7.6 "measured capacity"); the
-    adaptive-growth loop in :mod:`..api` sizes its rebuild from it."""
+    adaptive-growth loop in :mod:`..api` sizes its rebuild from it; it is
+    also the smallest ``mover_cap`` that would have kept the count-driven
+    engines off their dense fallback.
+
+    ``fallback`` ([R] int32, 1 where the shard's step took the in-graph
+    dense fallback — mover overflow past ``mover_cap``, or out-of-stencil
+    movers on the neighbor engine) is only emitted by the count-driven
+    sparse/neighbor engines; it defaults to ``None`` (an EMPTY pytree
+    node — zero leaves) so the dense engines' 5-leaf stats trees, their
+    shard_map out_specs, and every consumer that never looks at it are
+    untouched."""
 
     send_counts: jax.Array
     recv_counts: jax.Array
     dropped_send: jax.Array
     dropped_recv: jax.Array
     needed_capacity: jax.Array
+    fallback: jax.Array = None
 
 
 def shard_redistribute_fn(
@@ -392,6 +441,61 @@ def vrank_redistribute_planar_fn(
     return fn
 
 
+def _planar_shard_prefix(fused, count, domain, grid, D, edges, axes):
+    """Shared per-shard routing prefix of the planar/sparse/neighbor
+    multi-device engines: validate, bitcast to the int32 transport view,
+    bin destinations, and derive the stable pack permutation + per-dest
+    counts. Every multi-device planar-family engine runs EXACTLY this
+    code, which is what makes the count-driven engines' routing (and the
+    shared-prefix stats) bit-identical to the dense engine's by
+    construction.
+
+    Returns ``(as_f32, fi, n, me, is_self, order, remote_counts,
+    bounds)``.
+    """
+    R = grid.nranks
+    if fused.ndim != 2 or fused.shape[0] < D:
+        raise ValueError(
+            f"fused must be [K>={D}, n] per shard (K rows: {D} "
+            f"position components first, then 32-bit fields), got "
+            f"{fused.shape}"
+        )
+    if (
+        fused.dtype not in (jnp.float32, jnp.int32)
+        or np.dtype(fused.dtype).itemsize != 4
+    ):
+        raise TypeError(
+            f"fused must be float32 or int32, got {fused.dtype}"
+        )
+    as_f32 = fused.dtype == jnp.float32
+    fi = (
+        lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+    )
+    pos_f = (
+        fused[:D]
+        if as_f32
+        else lax.bitcast_convert_type(fi[:D], jnp.float32)
+    )
+    n = fused.shape[1]
+    me = lax.axis_index(axes).astype(jnp.int32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    valid = iota < count[0]
+    with traced_span("rd:bin"):
+        dest = binning.rank_of_position_planar(
+            pos_f, domain, grid, edges=edges
+        )
+        dest = jnp.where(valid, dest, R).astype(jnp.int32)
+        # Self-owned columns stay local (never hit the wire); sentinel
+        # R routes both invalid and self columns out of the remote
+        # pack.
+        is_self = valid & (dest == me)
+        dest_remote = jnp.where(is_self, R, dest)
+        order, remote_counts, bounds = binning.sorted_dest_counts(
+            dest_remote, R
+        )
+    return as_f32, fi, n, me, is_self, order, remote_counts, bounds
+
+
 def shard_redistribute_planar_fn(
     domain: Domain,
     grid: ProcessGrid,
@@ -428,42 +532,9 @@ def shard_redistribute_planar_fn(
     axes = grid.axis_names
 
     def fn(fused, count):
-        if fused.ndim != 2 or fused.shape[0] < D:
-            raise ValueError(
-                f"fused must be [K>={D}, n] per shard (K rows: {D} "
-                f"position components first, then 32-bit fields), got "
-                f"{fused.shape}"
-            )
-        if fused.dtype not in (jnp.float32, jnp.int32):
-            raise TypeError(
-                f"fused must be float32 or int32, got {fused.dtype}"
-            )
-        as_f32 = fused.dtype == jnp.float32
-        fi = (
-            lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+        as_f32, fi, n, me, is_self, order, remote_counts, bounds = (
+            _planar_shard_prefix(fused, count, domain, grid, D, edges, axes)
         )
-        pos_f = (
-            fused[:D]
-            if as_f32
-            else lax.bitcast_convert_type(fi[:D], jnp.float32)
-        )
-        n = fused.shape[1]
-        me = lax.axis_index(axes).astype(jnp.int32)
-        iota = jnp.arange(n, dtype=jnp.int32)
-        valid = iota < count[0]
-        with traced_span("rd:bin"):
-            dest = binning.rank_of_position_planar(
-                pos_f, domain, grid, edges=edges
-            )
-            dest = jnp.where(valid, dest, R).astype(jnp.int32)
-            # Self-owned columns stay local (never hit the wire); sentinel
-            # R routes both invalid and self columns out of the remote
-            # pack.
-            is_self = valid & (dest == me)
-            dest_remote = jnp.where(is_self, R, dest)
-            order, remote_counts, bounds = binning.sorted_dest_counts(
-                dest_remote, R
-            )
         dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
         send_counts = jnp.minimum(remote_counts, C)
         with traced_span("rd:pack"):
@@ -526,15 +597,677 @@ def shard_redistribute_planar_sharded(
     fn = shard_redistribute_planar_fn(
         domain, grid, capacity, out_capacity, ndim, edges=edges
     )
+    # 5 explicit specs: `fallback` stays at its None default (an empty
+    # pytree node) — the dense engine emits no fallback leaf.
+    out_specs = (
+        spec_f,
+        spec_c,
+        RedistributeStats(spec_c, spec_c, spec_c, spec_c, spec_c),
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec_f, spec_c), out_specs=out_specs
+    )
+
+
+# gridlint: fastpath-engine
+def _sparse_wire(fi, order, starts, counts, R, B, axes):
+    """Count-driven wire schedule: pack ``[K, R*B]`` mover blocks through
+    the precomputed pack plan and ``all_to_all`` them. O(movers) work
+    only — no sorts, no iota-indexed takes (G006-checked region; the
+    compaction sort lives outside, in the unpack phase)."""
+    with traced_span("rd:pack"):
+        packed, _ = pack.pack_cols(fi, order, starts, counts, R, B)
+    with traced_span("rd:exchange"):
+        return lax.all_to_all(
+            packed, axes, split_axis=1, concat_axis=1, tiled=True
+        )
+
+
+# gridlint: fastpath-engine
+def _neighbor_wire(fi, plan, slot_valid, axes, perms, n_act, B):
+    """Neighbor stencil wire schedule: ONE plan-indexed gather of every
+    outgoing mover column, then one static-perm ``lax.ppermute`` shift
+    per active stencil offset — ``n_act`` point-to-point neighbor
+    exchanges of ``[K, B]`` blocks instead of a dense ``[K, R*C]``
+    ``all_to_all``. O(movers) work only — no sorts, no iota-indexed
+    takes (G006-checked region)."""
+    K = fi.shape[0]
+    with traced_span("rd:pack"):
+        send = jnp.where(slot_valid[None, :], pack.gather_plan_cols(fi, plan), 0)
+    send = send.reshape(K, n_act, B)
+    with traced_span("rd:exchange"):
+        blocks = [
+            lax.ppermute(send[:, o, :], axes, perm=list(perms[o]))
+            for o in range(n_act)
+        ]
+    return jnp.concatenate(blocks, axis=1)
+
+
+def _dense_pool_wire(fi, order, starts, counts, R, C, axes):
+    """Dense ``[K, R*C]`` pool wire — the count-driven engines' in-graph
+    fallback, byte-identical to :func:`shard_redistribute_planar_fn`'s
+    exchange. Lives at module level so the cond branch functions stay
+    free of lexical collectives (the same G001 discipline as
+    migrate.py's dense fallback lambda)."""
+    with traced_span("rd:pack"):
+        packed, _ = pack.pack_cols(fi, order, starts, counts, R, C)
+    with traced_span("rd:exchange"):
+        return lax.all_to_all(
+            packed, axes, split_axis=1, concat_axis=1, tiled=True
+        )
+
+
+def _check_mover_cap(mover_cap, capacity):
+    B = int(mover_cap)
+    if not 1 <= B < int(capacity):
+        raise ValueError(
+            f"mover_cap must be in [1, capacity); got mover_cap={B}, "
+            f"capacity={capacity} — at mover_cap >= capacity the "
+            f"count-driven pool is no smaller than the dense one, build "
+            f"the planar engine instead"
+        )
+    return B
+
+
+def shard_redistribute_sparse_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """COUNT-DRIVEN multi-device canonical exchange (under ``shard_map``).
+
+    Same routing prefix, same Alltoallv receive order, same
+    capacity/overflow accounting as :func:`shard_redistribute_planar_fn`
+    — but the exchanged pool is ``[K, R*mover_cap]`` instead of
+    ``[K, R*capacity]``: per-step WIRE cost scales with movers, not
+    residents (the paper's Alltoallv rationale, SURVEY.md §3.2). The
+    counts ``all_to_all`` runs first (outside any branch); a globally
+    ``pmin``-agreed guard — every per-pair mover count fits the block —
+    then picks between the mover-block wire and a bit-identical dense
+    fallback in ONE ``lax.cond`` (PR 4's dispatch contract: every device
+    takes the same branch, so the branch-local collectives cannot
+    deadlock). Both branches feed the same payload-sort compaction with
+    identical valid slots in identical (source, slot) order, so the
+    output is byte-identical either way; ``stats.fallback`` reports
+    which branch ran, and ``stats.needed_capacity`` is exactly the
+    smallest ``mover_cap`` that would have kept the fast branch.
+
+    NOTE the compaction itself still touches every resident column (the
+    canonical output contract forces a full re-pack); it is the WIRE —
+    the pool riding ICI — that shrinks from residents to movers.
+    """
+    R = grid.nranks
+    C = capacity
+    B = _check_mover_cap(mover_cap, capacity)
+    D = domain.ndim if ndim is None else ndim
+    axes = grid.axis_names
+
+    def fn(fused, count):
+        as_f32, fi, n, me, is_self, order, remote_counts, bounds = (
+            _planar_shard_prefix(fused, count, domain, grid, D, edges, axes)
+        )
+        dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
+        send_counts = jnp.minimum(remote_counts, C)
+        with traced_span("rd:exchange"):
+            recv_counts = lax.all_to_all(
+                send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+        # Globally-agreed dispatch: pmin of the local fit so every device
+        # takes the SAME cond branch (a disagreeing branch would strand
+        # the branch-local collectives — see migrate.py's dispatch note).
+        ok = (jnp.max(remote_counts) <= B).astype(jnp.int32)
+        guard = lax.pmin(ok, axes)
+
+        def _count_driven(_):
+            pool = _sparse_wire(
+                fi, order, bounds[:R], jnp.minimum(send_counts, B), R, B,
+                axes,
+            )
+            with traced_span("rd:unpack"):
+                return pack.planar_compact_with_self(
+                    pool, recv_counts, me, is_self, fi, out_capacity
+                )
+
+        def _dense(_):
+            pool = _dense_pool_wire(
+                fi, order, bounds[:R], send_counts, R, C, axes
+            )
+            with traced_span("rd:unpack"):
+                return pack.planar_compact_with_self(
+                    pool, recv_counts, me, is_self, fi, out_capacity
+                )
+
+        out, new_count, dropped_recv = lax.cond(
+            guard == 1, _count_driven, _dense, operand=None
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32))
+        self_onehot = (jnp.arange(R, dtype=jnp.int32) == me) * self_count
+        stats = RedistributeStats(
+            send_counts=(send_counts + self_onehot)[None, :],
+            recv_counts=(recv_counts + self_onehot)[None, :],
+            dropped_send=dropped_send[None].astype(jnp.int32),
+            dropped_recv=dropped_recv[None],
+            needed_capacity=jnp.max(remote_counts)[None].astype(jnp.int32),
+            fallback=(1 - guard)[None].astype(jnp.int32),
+        )
+        return out, new_count[None], stats
+
+    return fn
+
+
+def shard_redistribute_neighbor_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """NEIGHBOR-STENCIL multi-device canonical exchange (``shard_map``).
+
+    Stage B of the count-driven wire: at drift-scale migration the flow
+    matrix is near-neighbor-banded on a Cartesian grid, so the dense
+    ``all_to_all`` is replaced by a static Moore-stencil ``ppermute``
+    shift schedule (:func:`..mesh.neighbor_tables` — ≤26 neighbor
+    exchanges of ``[K, mover_cap]`` blocks in 3D). The guard extends the
+    sparse engine's mover-fit check with stencil membership: any mover
+    bound beyond the 3x3x3 stencil flips the whole (globally
+    ``pmin``-agreed) step onto the bit-identical dense fallback, journaled
+    via ``stats.fallback``. Same routing prefix, same compaction ordering
+    (the receive keys feed :func:`..ops.pack.planar_compact_keys` with
+    the same source-major order), so output is byte-identical to
+    :func:`shard_redistribute_planar_fn` on every step.
+    """
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    R = grid.nranks
+    C = capacity
+    B = _check_mover_cap(mover_cap, capacity)
+    D = domain.ndim if ndim is None else ndim
+    axes = grid.axis_names
+    periodic = tuple(bool(p) for p in domain.periodic)
+    _, dst_t, src_t, member = mesh_lib.neighbor_tables(grid, periodic)
+    perms_all = mesh_lib.neighbor_perms(grid, periodic)
+    active = tuple(o for o in range(dst_t.shape[1]) if perms_all[o])
+    if not active:
+        raise ValueError(
+            f"neighbor engine needs a grid with at least one neighbor "
+            f"link, got shape {grid.shape}"
+        )
+    n_act = len(active)
+    perms = tuple(perms_all[o] for o in active)
+    dst_j = jnp.asarray(dst_t[:, active])        # [R, n_act]
+    src_j = jnp.asarray(src_t[:, active])        # [R, n_act]
+    member_j = jnp.asarray(member)               # [R, R] bool
+
+    def fn(fused, count):
+        as_f32, fi, n, me, is_self, order, remote_counts, bounds = (
+            _planar_shard_prefix(fused, count, domain, grid, D, edges, axes)
+        )
+        dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0))
+        send_counts = jnp.minimum(remote_counts, C)
+        with traced_span("rd:exchange"):
+            recv_counts = lax.all_to_all(
+                send_counts, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+        member_row = jnp.take(member_j, me, axis=0)  # [R] bool
+        # in-stencil movers must fit the block; out-of-stencil pairs must
+        # be EMPTY (the schedule has no route for them)
+        ok = jnp.all(
+            jnp.where(member_row, remote_counts <= B, remote_counts == 0)
+        ).astype(jnp.int32)
+        guard = lax.pmin(ok, axes)
+
+        def _stencil(_):
+            d_o = jnp.take(dst_j, me, axis=0)          # [n_act]
+            d_safe = jnp.where(d_o >= 0, d_o, 0)
+            sc_b = jnp.minimum(send_counts, B)
+            cnt = jnp.where(d_o >= 0, sc_b[d_safe], 0)  # [n_act]
+            c_idx = jnp.arange(B, dtype=jnp.int32)
+            flat_c = jnp.tile(c_idx, n_act)
+            off_i = jnp.repeat(jnp.arange(n_act, dtype=jnp.int32), B)
+            slot_valid = flat_c < cnt[off_i]
+            src_cols = jnp.minimum(bounds[d_safe][off_i] + flat_c, n - 1)
+            plan = order[src_cols]
+            pool = _neighbor_wire(fi, plan, slot_valid, axes, perms,
+                                  n_act, B)
+            # receive keys: block o arrived from src_j[me, o]; under the
+            # guard every source occupies exactly ONE block (the dedup in
+            # neighbor_tables), so (source, slot-iota) ordering matches
+            # the dense pool's — byte-identical compaction.
+            s_o = jnp.take(src_j, me, axis=0)          # [n_act]
+            s_safe = jnp.where(s_o >= 0, s_o, 0)
+            rc = jnp.where(s_o >= 0, recv_counts[s_safe], 0)
+            valid_r = flat_c < rc[off_i]
+            invalid = ~jnp.concatenate([valid_r, is_self])
+            source_key = jnp.concatenate(
+                [s_safe[off_i], jnp.broadcast_to(me, (n,))]
+            ).astype(jnp.int32)
+            values = jnp.concatenate([pool, fi], axis=1)
+            new_full = (
+                jnp.sum(recv_counts) + jnp.sum(is_self.astype(jnp.int32))
+            )
+            with traced_span("rd:unpack"):
+                return pack.planar_compact_keys(
+                    values, invalid, source_key, R, new_full, out_capacity
+                )
+
+        def _dense(_):
+            pool = _dense_pool_wire(
+                fi, order, bounds[:R], send_counts, R, C, axes
+            )
+            with traced_span("rd:unpack"):
+                return pack.planar_compact_with_self(
+                    pool, recv_counts, me, is_self, fi, out_capacity
+                )
+
+        out, new_count, dropped_recv = lax.cond(
+            guard == 1, _stencil, _dense, operand=None
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32))
+        self_onehot = (jnp.arange(R, dtype=jnp.int32) == me) * self_count
+        stats = RedistributeStats(
+            send_counts=(send_counts + self_onehot)[None, :],
+            recv_counts=(recv_counts + self_onehot)[None, :],
+            dropped_send=dropped_send[None].astype(jnp.int32),
+            dropped_recv=dropped_recv[None],
+            needed_capacity=jnp.max(remote_counts)[None].astype(jnp.int32),
+            fallback=(1 - guard)[None].astype(jnp.int32),
+        )
+        return out, new_count[None], stats
+
+    return fn
+
+
+def _validate_planar_vranks(fused, V, D):
+    if fused.ndim != 3 or fused.shape[0] != V or fused.shape[1] < D:
+        raise ValueError(
+            f"fused must be [V={V}, K>={D}, n] (K rows: {D} position "
+            f"components first, then 32-bit fields), got "
+            f"{fused.shape}"
+        )
+    if (
+        fused.dtype not in (jnp.float32, jnp.int32)
+        or np.dtype(fused.dtype).itemsize != 4
+    ):
+        raise TypeError(
+            f"fused must be float32 or int32, got {fused.dtype}"
+        )
+    as_f32 = fused.dtype == jnp.float32
+    fi = (
+        lax.bitcast_convert_type(fused, jnp.int32) if as_f32 else fused
+    )
+    pos_f = (
+        fused[:, :D, :]
+        if as_f32
+        else lax.bitcast_convert_type(fi[:, :D, :], jnp.float32)
+    )
+    return as_f32, fi, pos_f
+
+
+def _vrank_sparse_prefix(fi, pos_f, count, domain, grid, edges, n):
+    """Vmapped routing prefix of the vrank count-driven engines — the
+    same per-vrank binning/sort as :func:`vrank_redistribute_planar_fn`'s
+    ``pack_one``, split from the pack so both cond branches (mover-block
+    and dense widths) can share one plan."""
+    V = grid.nranks
+    me_ids = jnp.arange(V, dtype=jnp.int32)
+
+    def prefix_one(fi_v, pos_v, count_v, me):
+        iota = jnp.arange(n, dtype=jnp.int32)
+        valid = iota < count_v
+        with traced_span("rd:bin"):
+            dest = binning.rank_of_position_planar(
+                pos_v, domain, grid, edges=edges
+            )
+            dest = jnp.where(valid, dest, V).astype(jnp.int32)
+            is_self = valid & (dest == me)
+            dest_remote = jnp.where(is_self, V, dest)
+            order, remote_counts, bounds = binning.sorted_dest_counts(
+                dest_remote, V
+            )
+        return is_self, order, remote_counts, bounds
+
+    is_self, order, remote_counts, bounds = jax.vmap(prefix_one)(
+        fi, pos_f, count, me_ids
+    )
+    return me_ids, is_self, order, remote_counts, bounds
+
+
+def vrank_redistribute_sparse_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """COUNT-DRIVEN canonical exchange, vrank twin: the HBM-side "wire"
+    (the ``[V_src, K, V_dst, W]`` transpose) shrinks from ``W=capacity``
+    to ``W=mover_cap`` under the same globally-agreed one-``lax.cond``
+    guard as :func:`shard_redistribute_sparse_fn`; overflow falls back to
+    the bit-identical dense transpose. Lets a single chip run — and
+    honestly benchmark — the count-driven schedule at any R.
+    """
+    V = grid.nranks
+    C = capacity
+    B = _check_mover_cap(mover_cap, capacity)
+    D = domain.ndim if ndim is None else ndim
+
+    def fn(fused, count):
+        as_f32, fi, pos_f = _validate_planar_vranks(fused, V, D)
+        n = fused.shape[2]
+        K = fused.shape[1]
+        me_ids, is_self, order, remote_counts, bounds = (
+            _vrank_sparse_prefix(fi, pos_f, count, domain, grid, edges, n)
+        )
+        dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0), axis=1)
+        send_counts = jnp.minimum(remote_counts, C)
+        recv_counts = send_counts.T
+        needed = jnp.max(remote_counts, axis=1).astype(jnp.int32)
+        guard = jnp.max(remote_counts) <= B
+
+        def _tail(W):
+            def pack_one(fi_v, order_v, bounds_v, sc_v):
+                with traced_span("rd:pack"):
+                    packed, _ = pack.pack_cols(
+                        fi_v, order_v, bounds_v[:V],
+                        jnp.minimum(sc_v, W), V, W,
+                    )
+                return packed
+
+            packed = jax.vmap(pack_one)(fi, order, bounds, send_counts)
+            with traced_span("rd:exchange"):
+                pool = (
+                    packed.reshape(V, K, V, W)
+                    .transpose(2, 1, 0, 3)
+                    .reshape(V, K, V * W)
+                )
+
+            def compact_one(pool_v, rcnt_v, me, self_v, fi_v):
+                return pack.planar_compact_with_self(
+                    pool_v, rcnt_v, me, self_v, fi_v, out_capacity
+                )
+
+            with traced_span("rd:unpack"):
+                return jax.vmap(compact_one)(
+                    pool, recv_counts, me_ids, is_self, fi
+                )
+
+        out, new_count, dropped_recv = lax.cond(
+            guard, lambda _: _tail(B), lambda _: _tail(C), operand=None
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
+        self_diag = jnp.diag(self_count)
+        stats = RedistributeStats(
+            send_counts=send_counts + self_diag,
+            recv_counts=recv_counts + self_diag,
+            dropped_send=dropped_send.astype(jnp.int32),
+            dropped_recv=dropped_recv,
+            needed_capacity=needed,
+            fallback=jnp.broadcast_to(
+                (~guard).astype(jnp.int32), (V,)
+            ),
+        )
+        return out, new_count, stats
+
+    return fn
+
+
+def vrank_redistribute_neighbor_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """NEIGHBOR-STENCIL canonical exchange, vrank twin: the per-offset
+    ``ppermute`` shifts become static cross-vrank block gathers through
+    the same :func:`..mesh.neighbor_tables` the sharded engine ships, so
+    one chip exercises the exact stencil schedule (guard, fallback, block
+    order) the pod runs — bit-identical to the planar vrank engine.
+    """
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+    import numpy as np
+
+    V = grid.nranks
+    C = capacity
+    B = _check_mover_cap(mover_cap, capacity)
+    D = domain.ndim if ndim is None else ndim
+    periodic = tuple(bool(p) for p in domain.periodic)
+    _, dst_t, src_t, member = mesh_lib.neighbor_tables(grid, periodic)
+    perms_all = mesh_lib.neighbor_perms(grid, periodic)
+    active = tuple(o for o in range(dst_t.shape[1]) if perms_all[o])
+    if not active:
+        raise ValueError(
+            f"neighbor engine needs a grid with at least one neighbor "
+            f"link, got shape {grid.shape}"
+        )
+    n_act = len(active)
+    dst_act = dst_t[:, active]                    # np [V, n_act]
+    src_act = src_t[:, active]                    # np [V, n_act]
+    d_valid = jnp.asarray(dst_act >= 0)
+    d_safe = jnp.asarray(np.where(dst_act >= 0, dst_act, 0))
+    s_valid = jnp.asarray(src_act >= 0)
+    s_safe = jnp.asarray(np.where(src_act >= 0, src_act, 0))
+    member_j = jnp.asarray(member)                # [V, V] bool
+
+    def fn(fused, count):
+        as_f32, fi, pos_f = _validate_planar_vranks(fused, V, D)
+        n = fused.shape[2]
+        K = fused.shape[1]
+        me_ids, is_self, order, remote_counts, bounds = (
+            _vrank_sparse_prefix(fi, pos_f, count, domain, grid, edges, n)
+        )
+        dropped_send = jnp.sum(jnp.maximum(remote_counts - C, 0), axis=1)
+        send_counts = jnp.minimum(remote_counts, C)
+        recv_counts = send_counts.T
+        needed = jnp.max(remote_counts, axis=1).astype(jnp.int32)
+        guard = jnp.all(
+            jnp.where(member_j, remote_counts <= B, remote_counts == 0)
+        )
+
+        def _stencil(_):
+            sc_b = jnp.minimum(send_counts, B)
+            cnt = jnp.where(
+                d_valid, jnp.take_along_axis(sc_b, d_safe, axis=1), 0
+            )                                      # [V, n_act]
+            base = jnp.take_along_axis(bounds, d_safe, axis=1)
+            c_idx = jnp.arange(B, dtype=jnp.int32)
+            slot_valid = (
+                c_idx[None, None, :] < cnt[:, :, None]
+            ).reshape(V, n_act * B)
+            src_cols = jnp.minimum(
+                base[:, :, None] + c_idx[None, None, :], n - 1
+            ).reshape(V, n_act * B)
+            plan = jnp.take_along_axis(order, src_cols, axis=1)
+            with traced_span("rd:pack"):
+                send = jax.vmap(pack.gather_plan_cols)(fi, plan)
+                send = jnp.where(slot_valid[:, None, :], send, 0)
+            blocks = send.reshape(V, K, n_act, B)
+            with traced_span("rd:exchange"):
+                # block o at vrank v came from src_act[v, o] — the static
+                # cross-vrank gather the sharded twin does with one
+                # ppermute per offset
+                recv = blocks[
+                    s_safe, :, jnp.arange(n_act)[None, :], :
+                ]                                  # [V, n_act, K, B]
+                pool = recv.transpose(0, 2, 1, 3).reshape(V, K, n_act * B)
+            rc = jnp.where(
+                s_valid, jnp.take_along_axis(recv_counts, s_safe, axis=1),
+                0,
+            )                                      # [V, n_act]
+            valid_r = (
+                c_idx[None, None, :] < rc[:, :, None]
+            ).reshape(V, n_act * B)
+            invalid = ~jnp.concatenate([valid_r, is_self], axis=1)
+            source_key = jnp.concatenate(
+                [
+                    jnp.broadcast_to(
+                        s_safe[:, :, None], (V, n_act, B)
+                    ).reshape(V, n_act * B),
+                    jnp.broadcast_to(me_ids[:, None], (V, n)),
+                ],
+                axis=1,
+            ).astype(jnp.int32)
+            values = jnp.concatenate([pool, fi], axis=2)
+            new_full = jnp.sum(recv_counts, axis=1) + jnp.sum(
+                is_self.astype(jnp.int32), axis=1
+            )
+
+            def compact_one(vals_v, inv_v, sk_v, nf_v):
+                return pack.planar_compact_keys(
+                    vals_v, inv_v, sk_v, V, nf_v, out_capacity
+                )
+
+            with traced_span("rd:unpack"):
+                return jax.vmap(compact_one)(
+                    values, invalid, source_key, new_full
+                )
+
+        def _dense(_):
+            def pack_one(fi_v, order_v, bounds_v, sc_v):
+                with traced_span("rd:pack"):
+                    packed, _ = pack.pack_cols(
+                        fi_v, order_v, bounds_v[:V], sc_v, V, C
+                    )
+                return packed
+
+            packed = jax.vmap(pack_one)(fi, order, bounds, send_counts)
+            with traced_span("rd:exchange"):
+                pool = (
+                    packed.reshape(V, K, V, C)
+                    .transpose(2, 1, 0, 3)
+                    .reshape(V, K, V * C)
+                )
+
+            def compact_one(pool_v, rcnt_v, me, self_v, fi_v):
+                return pack.planar_compact_with_self(
+                    pool_v, rcnt_v, me, self_v, fi_v, out_capacity
+                )
+
+            with traced_span("rd:unpack"):
+                return jax.vmap(compact_one)(
+                    pool, recv_counts, me_ids, is_self, fi
+                )
+
+        out, new_count, dropped_recv = lax.cond(
+            guard, _stencil, _dense, operand=None
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
+        self_diag = jnp.diag(self_count)
+        stats = RedistributeStats(
+            send_counts=send_counts + self_diag,
+            recv_counts=recv_counts + self_diag,
+            dropped_send=dropped_send.astype(jnp.int32),
+            dropped_recv=dropped_recv,
+            needed_capacity=needed,
+            fallback=jnp.broadcast_to(
+                (~guard).astype(jnp.int32), (V,)
+            ),
+        )
+        return out, new_count, stats
+
+    return fn
+
+
+_COUNT_DRIVEN_SHARD_FNS = {
+    "sparse": shard_redistribute_sparse_fn,
+    "neighbor": shard_redistribute_neighbor_fn,
+}
+_COUNT_DRIVEN_VRANK_FNS = {
+    "sparse": vrank_redistribute_sparse_fn,
+    "neighbor": vrank_redistribute_neighbor_fn,
+}
+
+
+def shard_redistribute_count_driven_sharded(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+    engine: str = "sparse",
+):
+    """``shard_map``-wrapped count-driven exchange (``engine`` picks the
+    sparse all_to_all or neighbor ppermute wire). Same global layout as
+    :func:`shard_redistribute_planar_sharded`; the stats tree carries the
+    extra ``fallback`` leaf ([R] int32)."""
+    axes = grid.axis_names
+    spec_f = P(None, axes)
+    spec_c = P(axes)
+    fn = _COUNT_DRIVEN_SHARD_FNS[engine](
+        domain, grid, capacity, out_capacity, mover_cap, ndim, edges=edges
+    )
     out_specs = (
         spec_f,
         spec_c,
         RedistributeStats(
-            *([spec_c] * len(RedistributeStats._fields))
+            spec_c, spec_c, spec_c, spec_c, spec_c, spec_c
         ),
     )
     return shard_map(
         fn, mesh=mesh, in_specs=(spec_f, spec_c), out_specs=out_specs
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute_count_driven(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+    engine: str = "sparse",
+):
+    """jit of :func:`shard_redistribute_count_driven_sharded`."""
+    return jax.jit(
+        shard_redistribute_count_driven_sharded(
+            mesh, domain, grid, capacity, out_capacity, mover_cap, ndim,
+            edges=edges, engine=engine,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute_count_driven_vranks(
+    domain: Domain,
+    grid: ProcessGrid,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    ndim: int = None,
+    edges=None,
+    engine: str = "sparse",
+):
+    """jit of the count-driven vrank twins ([V, K, n] planar)."""
+    return jax.jit(
+        _COUNT_DRIVEN_VRANK_FNS[engine](
+            domain, grid, capacity, out_capacity, mover_cap, ndim,
+            edges=edges,
+        )
     )
 
 
@@ -611,7 +1344,8 @@ def build_redistribute(
     out_specs = (
         (spec, spec)
         + (spec,) * n_fields
-        + (RedistributeStats(*([spec] * len(RedistributeStats._fields))),)
+        # 5 explicit specs: no fallback leaf on the row-major engine
+        + (RedistributeStats(spec, spec, spec, spec, spec),)
     )
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return jax.jit(sharded)

@@ -9,8 +9,10 @@ axes reproduces the MPI rank ordering (row-major, x-major first).
 
 from __future__ import annotations
 
+import functools
+import itertools
 import math
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -104,6 +106,98 @@ def make_hybrid_mesh(
         ici = tuple(g // d for g, d in zip(grid.shape, dcn_shape))
         devices = mesh_utils.create_hybrid_device_mesh(ici, dcn_shape)
     return Mesh(devices, grid.axis_names)
+
+
+def stencil_offsets(ndim: int) -> Tuple[Tuple[int, ...], ...]:
+    """The nonzero offsets of the 3^ndim Moore stencil, in a fixed
+    (itertools.product) order — 26 in 3D. The neighbor exchange engine
+    assigns one ``ppermute`` shift per offset, so the order here is the
+    wire schedule's block order and must stay deterministic."""
+    return tuple(
+        off
+        for off in itertools.product((-1, 0, 1), repeat=ndim)
+        if any(off)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def neighbor_tables(
+    grid: ProcessGrid, periodic: Tuple[bool, ...]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Static Moore-stencil routing tables for ``grid``.
+
+    Returns ``(offsets, dst, src, member)``:
+
+    * ``offsets [n_off, ndim]`` — :func:`stencil_offsets` as an array;
+    * ``dst [R, n_off] int32`` — rank that rank ``r``'s offset-``o``
+      neighbor resolves to (periodic wrap per ``periodic[a]``), or ``-1``
+      when the offset leaves a non-periodic grid, wraps onto ``r``
+      itself, or duplicates an earlier offset's destination (extent-1/2
+      axes alias neighbors; keeping only the FIRST offset per
+      ``(r, dst)`` pair makes every per-offset ``ppermute`` perm
+      injective);
+    * ``src [R, n_off] int32`` — the rank whose offset-``o`` neighbor is
+      ``r`` (i.e. the sender of block ``o`` arriving at ``r``), ``-1``
+      when none — the receive-side mirror of ``dst``;
+    * ``member [R, R] bool`` — ``member[r, d]`` true when ``d`` is
+      reachable from ``r`` within the stencil (incl. ``d == r``); the
+      out-of-stencil guard of the neighbor engine.
+    """
+    offs = stencil_offsets(grid.ndim)
+    n_off = len(offs)
+    R = grid.nranks
+    dst = np.full((R, n_off), -1, dtype=np.int32)
+    member = np.zeros((R, R), dtype=bool)
+    for r in range(R):
+        member[r, r] = True
+        cell = grid.cell_of_rank(r)
+        seen = set()
+        for o, off in enumerate(offs):
+            c = []
+            ok = True
+            for a in range(grid.ndim):
+                x = cell[a] + off[a]
+                g = grid.shape[a]
+                if periodic[a]:
+                    x %= g
+                elif not 0 <= x < g:
+                    ok = False
+                    break
+                c.append(x)
+            if not ok:
+                continue
+            d = grid.rank_of_cell(tuple(c))
+            if d == r or d in seen:
+                continue
+            seen.add(d)
+            dst[r, o] = d
+            member[r, d] = True
+    src = np.full((R, n_off), -1, dtype=np.int32)
+    for o in range(n_off):
+        for r in range(R):
+            d = dst[r, o]
+            if d >= 0:
+                src[d, o] = r
+    return np.asarray(offs, dtype=np.int32), dst, src, member
+
+
+def neighbor_perms(
+    grid: ProcessGrid, periodic: Tuple[bool, ...]
+) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+    """Per-offset ``ppermute`` perm lists over the FLAT rank space (the
+    mesh axes tuple, row-major — exactly ``lax.axis_index(axis_names)``):
+    ``perms[o] = ((r, dst[r, o]), ...)`` over ranks with a valid
+    offset-``o`` neighbor. Each perm is injective by the dedup in
+    :func:`neighbor_tables`."""
+    _, dst, _, _ = neighbor_tables(grid, tuple(periodic))
+    return tuple(
+        tuple(
+            (int(r), int(dst[r, o]))
+            for r in range(grid.nranks)
+            if dst[r, o] >= 0
+        )
+        for o in range(dst.shape[1])
+    )
 
 
 def validate_mesh_for_grid(mesh: Mesh, grid: ProcessGrid) -> None:

@@ -2,7 +2,12 @@
 
 The general :mod:`exchange` path re-packs every particle into canonical MPI
 ``Alltoallv`` receive order each step — full-array gathers plus a pool-wide
-stable sort. Profiling on the real chip shows the true TPU cost model:
+stable sort. (Its WIRE cost is now also mover-scaled: the count-driven
+``sparse``/``neighbor`` canonical engines in :mod:`exchange` ship
+``mover_cap``-wide pools over ``all_to_all``/``ppermute`` with an
+in-graph dense fallback — this module keeps the mover-scaled COMPUTE
+story for resident-slot state.) Profiling on the real chip shows the
+true TPU cost model:
 
   * random-access scatter costs ~76-85 ns *per row* regardless of row width
     (measured in BOTH layouts; see below) — scatters must be few and sized

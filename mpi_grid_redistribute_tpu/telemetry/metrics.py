@@ -414,6 +414,9 @@ def from_journal(
     * ``step_time_seconds`` — pow2 histogram of ``step_time`` samples;
     * ``fast_path_steps_total{taken}`` + ``movers_per_step`` histogram;
     * ``capacity_rows{which}`` — latest ratcheted capacity per budget;
+    * ``exchange_wire_bytes_total{engine}`` — scheduled canonical-
+      exchange wire bytes per engine over the journaled
+      ``redistribute`` window;
     * ``alerts_total{rule,severity}`` — health findings journaled;
     * ``flow_moved_rows`` / ``flow_imbalance`` — latest flow snapshot.
     """
@@ -472,6 +475,12 @@ def from_journal(
         " mover_cap_grow events)",
         ("which",),
     )
+    wire = reg.counter(
+        f"{p}_exchange_wire_bytes",
+        "Scheduled canonical-exchange wire bytes by resolved engine"
+        " (redistribute events; pool width x row bytes x shards)",
+        ("engine",),
+    )
     alerts = reg.counter(
         f"{p}_alerts",
         "Health-rule findings journaled as alert events",
@@ -511,6 +520,11 @@ def from_journal(
         elif kind == "mover_cap_grow":
             if "new" in data:
                 cap_g.labels(which="mover").set(int(data["new"]))
+        elif kind == "redistribute":
+            if "wire_bytes" in data:
+                wire.labels(
+                    engine=data.get("engine", "unknown")
+                ).inc(int(data["wire_bytes"]))
         elif kind == "alert":
             alerts.labels(
                 rule=data.get("rule", "unknown"),

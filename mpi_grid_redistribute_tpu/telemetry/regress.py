@@ -75,6 +75,13 @@ GUARDED_METRICS: Dict[str, str] = {
     # snapshot writer — so durability cannot silently get expensive.
     # Skipped against captures that predate the soak field.
     "soak_pps": "higher",
+    # scheduled canonical-exchange wire bytes per step (ISSUE 7
+    # count-driven engines): pool width x row bytes x shards, the cost
+    # the mover-sparse wire exists to shrink. Guarded LOWER so a change
+    # cannot silently re-widen the pool back toward the dense [K, R*C]
+    # schedule while pps holds. Auto-arms: skipped against histories
+    # that predate the field (the PR 3 pattern).
+    "exchange_wire_bytes_per_step": "lower",
 }
 
 # nested fallbacks: a metric missing at the top level of the parsed
@@ -86,6 +93,7 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "exchange_bytes_per_sec": ("report", "exchange_bytes_per_sec"),
     "stress_bw_util": ("stress", "bw_util"),
     "soak_pps": ("soak", "value"),
+    "exchange_wire_bytes_per_step": ("report", "wire_bytes_per_step"),
 }
 
 

@@ -61,6 +61,9 @@ def exchange_report(
     domain: str = "hbm",
     n_chips: int = 1,
     recorder=None,
+    engine_wire_cols: Optional[int] = None,
+    dense_wire_cols: Optional[int] = None,
+    wire_shards: Optional[int] = None,
 ) -> Dict[str, object]:
     """Merged metrics dict for one exchange workload.
 
@@ -81,6 +84,15 @@ def exchange_report(
       n_chips: chips sharing the aggregate byte rate.
       recorder: optional :class:`..telemetry.recorder.StepRecorder`; its
         all-time per-kind counts land under ``"events"``.
+      engine_wire_cols / dense_wire_cols / wire_shards: the scheduled
+        wire model of the dispatched canonical engine — per-shard pool
+        columns the exchange collective actually moves, the dense
+        ``R * capacity`` columns it replaced, and the shard count.
+        When given, ``wire_bytes_per_step`` reports the SCHEDULED bytes
+        on the wire (pool width x row bytes x shards; fallback steps
+        folded in at the dense width) — distinct from
+        ``moved_bytes_per_step``, which counts occupied rows only. The
+        count-driven engines shrink the former toward the latter.
 
     The dict is JSON-serializable (plain floats/ints/strs/dicts).
     """
@@ -132,6 +144,28 @@ def exchange_report(
         taken = int(np.count_nonzero(fp.any(axis=1)))
         out["fast_path_steps"] = taken
         out["fast_path_hit_rate"] = taken / fp.shape[0] if fp.shape[0] else None
+    # count-driven fallback trace (ISSUE 7): `fallback` is a [..., R] 1/0
+    # guard trace on sparse/neighbor canonical stats (1 = that step took
+    # the dense in-graph fallback); dense engines carry None and omit
+    # the section. Any rank falling back means ALL did (the pmin guard).
+    fb_rate = 0.0
+    fb = getattr(stats, "fallback", None)
+    if fb is not None:
+        fb = np.asarray(fb).reshape(-1, np.asarray(fb).shape[-1])
+        fell = int(np.count_nonzero(fb.any(axis=1)))
+        out["fallback_steps"] = fell
+        out["fallback_rate"] = fell / fb.shape[0] if fb.shape[0] else None
+        fb_rate = fell / fb.shape[0] if fb.shape[0] else 0.0
+    # scheduled wire-cost model (ISSUE 7): what the exchange collective
+    # puts on the wire regardless of occupancy; fallback steps billed at
+    # the dense width they actually ran at
+    if engine_wire_cols is not None and wire_shards is not None:
+        cols = float(engine_wire_cols)
+        if dense_wire_cols is not None:
+            dense_bps = float(dense_wire_cols) * row_bytes * int(wire_shards)
+            out["dense_wire_bytes_per_step"] = dense_bps
+            cols = cols * (1.0 - fb_rate) + float(dense_wire_cols) * fb_rate
+        out["wire_bytes_per_step"] = cols * row_bytes * int(wire_shards)
     if recorder is not None:
         out["events"] = recorder.counts()
         out["events_evicted"] = recorder.evicted

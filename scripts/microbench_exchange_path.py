@@ -1,0 +1,192 @@
+"""Canonical exchange wire engines vs mover fraction (ISSUE 7).
+
+The claim behind the count-driven wire is a *scaling* one: the dense
+planar exchange schedules the full ``[K, R*C]`` pool on the
+``all_to_all`` every step no matter how few rows actually change owner,
+while the sparse engine ships ``[K, R*B]`` (and the neighbor engine
+``[K, offsets*B]`` over ``ppermute`` shifts) with ``B`` sized to the
+mover load. This driver measures exactly that: fixed resident count,
+exactly-targeted 1% / 5% / 25% mover fractions (rows stepped one cell
+across the six face neighbors round-robin), each timed under
+``planar`` / ``sparse`` / ``neighbor`` with ``mover_cap`` sized from
+the measured per-destination peak — so the guard holds and every step
+stays on the fast branch. Scheduled wire bytes are reported alongside
+the times: on a CPU mesh the all_to_all is a memcpy, so the TIME gap
+understates what an ICI wire would see; the ``wire_bytes_per_step``
+column is the transport-independent claim.
+
+CPU-runnable on the sharded builders when the process has >= R devices
+(run under ``XLA_FLAGS=--xla_force_host_platform_device_count=8``,
+as tests/conftest.py does), on the vrank twins otherwise. One JSON row
+per (engine, fraction) on stdout — same ``metric``/``value``/
+``ms_per_step`` contract as the bench drivers, so telemetry.regress
+can diff captures.
+
+Usage: python scripts/microbench_exchange_path.py [n_local] [steps]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.bench import common
+from mpi_grid_redistribute_tpu.parallel import exchange
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+GRID_SHAPE = (2, 2, 2)
+K = 7  # pos(3) + vel(3) + alive — the drift loop's fused row
+
+
+def _state(grid, n_local, frac, rng):
+    """Shard-local [R, K, n] fused state with exactly ``frac * n``
+    movers per rank, spread over the six face neighbors; returns the
+    per-destination peak that sizes the mover block."""
+    shape = grid.shape
+    R = grid.nranks
+    m = max(1, int(round(frac * n_local)))
+    pos = np.empty((R, 3, n_local), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        for a in range(3):
+            w = 1.0 / shape[a]
+            pos[r, a] = (cell[a] + rng.random(n_local)) * w
+        for i in range(m):
+            axis = (i % 6) // 2
+            sign = 1.0 if i % 2 == 0 else -1.0
+            pos[r, axis, i] = np.mod(
+                pos[r, axis, i] + sign / shape[axis], 1.0
+            )
+    other = rng.standard_normal((R, K - 3, n_local)).astype(np.float32)
+    fused = np.concatenate([pos, other], axis=1)
+    count = np.full(R, n_local, np.int32)
+    # measured per-destination peak (opposite faces may be the same
+    # periodic neighbor on a 2-wide axis, so count real cells)
+    sh = np.asarray(shape)
+    peak = 0
+    for r in range(R):
+        cells = np.floor(pos[r].T * sh).astype(np.int64) % sh
+        flat = (cells[:, 0] * sh[1] + cells[:, 1]) * sh[2] + cells[:, 2]
+        c = grid.cell_of_rank(r)
+        home = (c[0] * sh[1] + c[1]) * sh[2] + c[2]
+        away = flat[flat != home]
+        if away.size:
+            peak = max(peak, int(np.bincount(away).max()))
+    return fused, count, peak
+
+
+def _time_calls(f, args, steps):
+    import jax
+
+    out = f(*args)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps, out
+
+
+def run(n_local: int = 1 << 13, steps: int = 30) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    grid = ProcessGrid(GRID_SHAPE)
+    R = grid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    sharded = len(jax.devices()) >= R
+    mesh = (
+        mesh_lib.make_mesh(grid, jax.devices()[:R]) if sharded else None
+    )
+    rng = np.random.default_rng(0)
+    cap = 1 << int(np.ceil(np.log2(2 * n_local / R)))  # dense per-dest
+    out_cap = 2 * n_local
+    n_off = None
+    rows = []
+    for frac in (0.01, 0.05, 0.25):
+        fused, count, peak = _state(grid, n_local, frac, rng)
+        B = min(cap // 2, 1 << int(np.ceil(np.log2(1.5 * peak))))
+        if sharded:
+            fused_dev = jnp.asarray(
+                np.transpose(fused, (1, 0, 2)).reshape(K, R * n_local)
+            )
+        else:
+            fused_dev = jnp.asarray(fused)
+        count_dev = jnp.asarray(count)
+        ref_out = None
+        for engine in ("planar", "sparse", "neighbor"):
+            if engine == "planar":
+                f = (
+                    exchange.build_redistribute_planar(
+                        mesh, domain, grid, cap, out_cap, 3
+                    )
+                    if sharded
+                    else exchange.build_redistribute_planar_vranks(
+                        domain, grid, cap, out_cap, 3
+                    )
+                )
+                cols = R * cap
+            else:
+                f = (
+                    exchange.build_redistribute_count_driven(
+                        mesh, domain, grid, cap, out_cap, B, 3,
+                        engine=engine,
+                    )
+                    if sharded
+                    else exchange.build_redistribute_count_driven_vranks(
+                        domain, grid, cap, out_cap, B, 3, engine=engine,
+                    )
+                )
+                if engine == "sparse":
+                    cols = R * B
+                else:
+                    if n_off is None:
+                        n_off = sum(
+                            1
+                            for p in mesh_lib.neighbor_perms(
+                                grid, tuple(domain.periodic)
+                            )
+                            if p
+                        )
+                    cols = n_off * B
+            per_step, out = _time_calls(f, (fused_dev, count_dev), steps)
+            if engine == "planar":
+                ref_out = np.asarray(out[0]).tobytes()
+            else:
+                assert np.asarray(out[0]).tobytes() == ref_out, (
+                    engine, frac, "engines diverged — not a benchmark",
+                )
+                fb = np.asarray(out[2].fallback)
+                assert not fb.any(), (engine, frac, "fell back dense")
+            row = {
+                "metric": f"exchange_path_{engine}_f{int(frac*100):02d}",
+                "value": round(1.0 / per_step, 2),
+                "unit": "calls/s",
+                "ms_per_step": round(per_step * 1e3, 4),
+                "engine": engine,
+                "layout": "sharded" if sharded else "vranks",
+                "n_local": n_local,
+                "mover_fraction": frac,
+                "mover_cap": None if engine == "planar" else B,
+                # the transport-independent claim: scheduled pool bytes
+                "wire_bytes_per_step": float(cols * 4 * K * R),
+            }
+            rows.append(row)
+            common.log(
+                f"exchange_path {engine} frac={frac:.0%}: "
+                f"{per_step*1e3:.3f} ms/call, "
+                f"wire {row['wire_bytes_per_step']/1e3:.1f} kB"
+            )
+    return rows
+
+
+if __name__ == "__main__":
+    n_local = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 13
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    for row in run(n_local, steps):
+        common.emit(row)

@@ -1,0 +1,363 @@
+"""Count-driven canonical exchange (ISSUE 7): bit-identity vs the
+planar engine, wire-schedule structure, API dispatch and telemetry.
+
+The sparse/neighbor engines are *engines*, not semantics: with any
+``mover_cap`` they must reproduce the dense planar exchange's output
+bit-for-bit (payload bytes AND counts AND stats prefix) — via the
+``[K, R*B]`` count-driven pool when every shard's movers fit, via the
+one-``lax.cond`` dense fallback when any shard overflows. What makes
+them worth having is structural, so it is asserted structurally: the
+neighbor fast branch is a ``ppermute`` shift schedule with NO dense
+``all_to_all``, and the sparse dispatch cond's branches disagree on
+pool width — invisible to correctness suites, the worst kind of
+regression (see analysis/rules_fastpath.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_grid_redistribute_tpu import api
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.parallel import exchange
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+# (shape, periodic, mover_cap, n_local, cap, out_cap, drift)
+CASES = [
+    ((2, 2, 2), (True, True, True), 16, 120, 60, 300, 0.01),
+    ((2, 2, 2), (True, True, True), 8, 120, 60, 300, 0.0),  # zero movers
+    ((4, 2, 1), (False, False, False), 16, 100, 64, 300, 0.008),
+    # tiny block + full reshuffle: every shard MUST take the fallback
+    ((2, 2, 2), (True, True, True), 2, 120, 100, 400, 0.45),
+]
+IDS = ["g222-drift", "g222-zero", "g421-nonperiodic", "g222-reshuffle"]
+
+
+def _inputs(shape, n_local, drift, rng, K=7):
+    """Shard-local particles plus a gaussian drift: a realistic mover
+    fraction, [R, K, n] vrank layout."""
+    grid = ProcessGrid(shape=shape)
+    R = grid.nranks
+    pos = np.empty((R, 3, n_local), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        for a in range(3):
+            w = 1.0 / shape[a]
+            pos[r, a] = (cell[a] + rng.random(n_local)) * w
+    pos = pos + rng.normal(0, drift, size=pos.shape).astype(np.float32)
+    pos = np.mod(pos, 1.0).astype(np.float32)
+    other = rng.standard_normal((R, K - 3, n_local)).astype(np.float32)
+    fused = np.concatenate([pos, other], axis=1)
+    count = rng.integers(
+        n_local // 2, n_local + 1, size=R
+    ).astype(np.int32)
+    return grid, fused, count
+
+
+@pytest.mark.parametrize("engine", ["sparse", "neighbor"])
+@pytest.mark.parametrize(
+    "shape,periodic,B,n_local,cap,out_cap,drift", CASES, ids=IDS
+)
+def test_count_driven_matches_planar_bitexact(
+    shape, periodic, B, n_local, cap, out_cap, drift, engine, rng,
+    _devices,
+):
+    grid, fused, count = _inputs(shape, n_local, drift, rng)
+    R = grid.nranks
+    domain = Domain(lo=(0.0,) * 3, hi=(1.0,) * 3, periodic=periodic)
+    mesh = mesh_lib.make_mesh(grid, jax.devices()[:R])
+    K = fused.shape[1]
+    fused_g = jnp.asarray(
+        np.transpose(fused, (1, 0, 2)).reshape(K, R * n_local)
+    )
+    count_g = jnp.asarray(count)
+
+    ref = exchange.build_redistribute_planar(
+        mesh, domain, grid, cap, out_cap, 3
+    )
+    out_p, cnt_p, st_p = ref(fused_g, count_g)
+    f = exchange.build_redistribute_count_driven(
+        mesh, domain, grid, cap, out_cap, B, 3, engine=engine
+    )
+    out_s, cnt_s, st_s = f(fused_g, count_g)
+    assert np.asarray(out_s).tobytes() == np.asarray(out_p).tobytes()
+    assert np.array_equal(np.asarray(cnt_s), np.asarray(cnt_p))
+    # the 5-leaf stats prefix matches the dense engine's exactly
+    for name in ("send_counts", "recv_counts", "dropped_send",
+                 "dropped_recv", "needed_capacity"):
+        assert np.array_equal(
+            np.asarray(getattr(st_s, name)),
+            np.asarray(getattr(st_p, name)),
+        ), name
+    fb = np.asarray(st_s.fallback)
+    if drift == 0.45:
+        assert fb.all(), "full reshuffle past mover_cap must fall back"
+    elif drift == 0.0:
+        assert not fb.any(), "zero movers must stay on the fast branch"
+
+    # vrank twin: same engine, [R, K, n] single-device layout — equal to
+    # the planar vrank twin AND to the sharded global result
+    fused_v = jnp.asarray(fused)
+    ref_v = exchange.build_redistribute_planar_vranks(
+        domain, grid, cap, out_cap, 3
+    )
+    out_pv, cnt_pv, _ = ref_v(fused_v, count_g)
+    fv = exchange.build_redistribute_count_driven_vranks(
+        domain, grid, cap, out_cap, B, 3, engine=engine
+    )
+    out_sv, cnt_sv, _ = fv(fused_v, count_g)
+    assert np.asarray(out_sv).tobytes() == np.asarray(out_pv).tobytes()
+    assert np.array_equal(np.asarray(cnt_sv), np.asarray(cnt_pv))
+    out_g = np.transpose(np.asarray(out_sv), (1, 0, 2)).reshape(
+        K, R * out_cap
+    )
+    assert out_g.tobytes() == np.asarray(out_p).tobytes()
+
+
+# ------------------------------------------------------- wire structure
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for j in _as_jaxprs(v):
+                yield from _walk_eqns(j)
+
+
+def _as_jaxprs(v):
+    if hasattr(v, "eqns"):
+        return [v]
+    if hasattr(v, "jaxpr"):
+        return [v.jaxpr]
+    if isinstance(v, (list, tuple)):
+        return [j for x in v for j in _as_jaxprs(x)]
+    return []
+
+
+def _prims(jaxpr):
+    return {e.primitive.name for e in _walk_eqns(jaxpr)}
+
+
+def _dispatch_conds(jaxpr, prim):
+    """Cond eqns whose branches DISAGREE about containing ``prim`` —
+    the engine-dispatch cond's signature (fast and dense branches are
+    structurally different by construction)."""
+    out = []
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = list(eqn.params["branches"])
+        flags = [prim in _prims(b.jaxpr) for b in branches]
+        if len(set(flags)) == 2:
+            out.append((branches[flags.index(False)].jaxpr,
+                        branches[flags.index(True)].jaxpr))
+    return out
+
+
+def test_neighbor_schedule_is_ppermute_no_dense_all_to_all(_devices):
+    grid = ProcessGrid(shape=(2, 2, 2))
+    domain = Domain(lo=(0.0,) * 3, hi=(1.0,) * 3, periodic=(True,) * 3)
+    mesh = mesh_lib.make_mesh(grid, jax.devices()[:8])
+    f = exchange.shard_redistribute_count_driven_sharded(
+        mesh, domain, grid, 64, 256, 8, 3, engine="neighbor"
+    )
+    jaxpr = jax.make_jaxpr(f)(
+        jnp.zeros((7, 8 * 64), jnp.float32),
+        jnp.zeros((8,), jnp.int32),
+    ).jaxpr
+    conds = _dispatch_conds(jaxpr, "all_to_all")
+    assert conds, "neighbor dispatch cond not found"
+    for fast, dense in conds:
+        fast_prims = _prims(fast)
+        # the fast branch is the ppermute shift schedule — never the
+        # dense pool exchange
+        assert "ppermute" in fast_prims
+        assert "all_to_all" not in fast_prims
+        assert "ppermute" not in _prims(dense)
+
+
+def test_sparse_dispatch_cond_separates_pool_widths(_devices):
+    grid = ProcessGrid(shape=(2, 2, 2))
+    domain = Domain(lo=(0.0,) * 3, hi=(1.0,) * 3, periodic=(True,) * 3)
+    mesh = mesh_lib.make_mesh(grid, jax.devices()[:8])
+    cap, B = 64, 8
+    f = exchange.shard_redistribute_count_driven_sharded(
+        mesh, domain, grid, cap, 256, B, 3, engine="sparse"
+    )
+    jaxpr = jax.make_jaxpr(f)(
+        jnp.zeros((7, 8 * 64), jnp.float32),
+        jnp.zeros((8,), jnp.int32),
+    ).jaxpr
+    # both branches exchange (sparse still rides all_to_all — at B, not
+    # cap, columns per destination), so find the dispatch cond by the
+    # branches' all_to_all operand widths instead
+    widths = []
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        per_branch = []
+        for b in eqn.params["branches"]:
+            w = [
+                int(np.prod(e.invars[0].aval.shape))
+                for e in _walk_eqns(b.jaxpr)
+                if e.primitive.name == "all_to_all"
+            ]
+            per_branch.append(max(w) if w else 0)
+        if len(set(per_branch)) == 2 and min(per_branch) > 0:
+            widths.append(sorted(per_branch))
+    assert widths, "sparse dispatch cond not found"
+    for narrow, wide in widths:
+        # the sparse pool is B/cap of the dense pool, per payload row
+        assert narrow * cap == wide * B
+
+
+# ---------------------------------------------------------- API dispatch
+
+
+def _mk_rows(grid, n_local, drift, rng):
+    """[N, 3] shard-local row positions + int32 ids (API layout)."""
+    R = grid.nranks
+    pos = np.empty((R * n_local, 3), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        for a in range(3):
+            w = 1.0 / grid.shape[a]
+            pos[r * n_local:(r + 1) * n_local, a] = (
+                cell[a] + rng.random(n_local)
+            ) * w
+    pos = np.mod(pos + rng.normal(0, drift, pos.shape), 1.0).astype(
+        np.float32
+    )
+    return pos, np.arange(R * n_local, dtype=np.int32)
+
+
+def _rd(shape, engine, **kw):
+    return api.GridRedistribute(
+        grid=shape, lo=(0.0,) * 3, hi=(1.0,) * 3,
+        periodic=(True,) * 3, engine=engine, **kw
+    )
+
+
+def test_api_auto_routes_sparse_and_journals_once(rng, _devices):
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.02, rng)
+    rd_a = _rd((2, 2, 2), "auto")
+    rd_p = _rd((2, 2, 2), "planar")
+    res_a = rd_a.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    assert np.asarray(res_a.positions).tobytes() == np.asarray(
+        res_p.positions
+    ).tobytes()
+    assert np.array_equal(
+        np.asarray(res_a.count), np.asarray(res_p.count)
+    )
+    ev = [e for e in rd_a.telemetry.events()
+          if e.kind == "engine_resolved"]
+    assert [e.data["resolved"] for e in ev] == ["sparse"]
+    assert ev[0].data["requested"] == "auto"
+    # second call, same routing inputs: journaled once, not per call
+    rd_a.redistribute(pos, ids)
+    assert len([e for e in rd_a.telemetry.events()
+                if e.kind == "engine_resolved"]) == 1
+    # the redistribute event carries the scheduled wire bytes
+    ev_rd = [e for e in rd_a.telemetry.events()
+             if e.kind == "redistribute"]
+    assert ev_rd[-1].data["engine"] == "sparse"
+    assert ev_rd[-1].data["wire_bytes"] > 0
+    rep = rd_a.report()
+    assert rep["engine"] == "sparse"
+    assert rep["fallback_steps"] == 0
+    assert (
+        rep["wire_bytes_per_step"] < rep["dense_wire_bytes_per_step"]
+    )
+    # ... and feeds the OpenMetrics counter family
+    assert "grid_exchange_wire_bytes_total" in rd_a.metrics(render=True)
+
+
+def test_api_neighbor_bitexact(rng, _devices):
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.02, rng)
+    res_n = _rd((2, 2, 2), "neighbor").redistribute(pos, ids)
+    res_p = _rd((2, 2, 2), "planar").redistribute(pos, ids)
+    assert np.asarray(res_n.positions).tobytes() == np.asarray(
+        res_p.positions
+    ).tobytes()
+
+
+def test_api_vranks_auto_planar_explicit_sparse(rng, _devices):
+    # 27 ranks > 8 devices: single-device vrank build. auto keeps the
+    # dense planar engine (no wire to shrink on one device); explicit
+    # sparse opts into the count-driven vrank engine, bit-identically.
+    grid = ProcessGrid((3, 3, 3))
+    pos, ids = _mk_rows(grid, 40, 0.01, rng)
+    rd_a = _rd((3, 3, 3), "auto", capacity=16)
+    rd_s = _rd((3, 3, 3), "sparse", capacity=16)
+    res_a = rd_a.redistribute(pos, ids)
+    res_s = rd_s.redistribute(pos, ids)
+    assert np.asarray(res_s.positions).tobytes() == np.asarray(
+        res_a.positions
+    ).tobytes()
+    assert rd_a.report()["engine"] == "planar"
+    assert rd_s.report()["engine"] == "sparse"
+
+
+def test_api_fallback_surfaced_and_billed_dense(rng, _devices):
+    # mover_cap=1 + a 45%-drift reshuffle: the in-graph dense fallback
+    # IS the result under on_overflow='ignore' (no lossy branch exists —
+    # out_capacity is sized up), surfaced in the report and billed at
+    # dense width in the wire model
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.45, rng)
+    rd_f = _rd((2, 2, 2), "sparse", mover_cap=1, capacity=96,
+               out_capacity=256, on_overflow="ignore")
+    rd_p = _rd((2, 2, 2), "planar", capacity=96, out_capacity=256,
+               on_overflow="ignore")
+    res_f = rd_f.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    assert np.asarray(res_f.positions).tobytes() == np.asarray(
+        res_p.positions
+    ).tobytes()
+    rep = rd_f.report()
+    assert rep["fallback_steps"] == 1
+    assert (
+        rep["wire_bytes_per_step"] == rep["dense_wire_bytes_per_step"]
+    )
+
+
+def test_api_mover_cap_ratchets_from_measured_need(rng, _devices):
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.05, rng)
+    rd = _rd((2, 2, 2), "sparse", mover_cap=1, capacity=96,
+             out_capacity=256)
+    rd.redistribute(pos, ids)
+    assert rd._mover_cap > 1
+    grow = [e for e in rd.telemetry.events()
+            if e.kind == "mover_cap_grow"]
+    assert grow and grow[-1].data["new"] == rd._mover_cap
+
+
+def test_api_explicit_count_driven_needs_planar_payload(rng, _devices):
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 32, 0.0, rng)
+    rd = _rd((2, 2, 2), "sparse")
+    with pytest.raises(TypeError, match="32-bit"):
+        rd.redistribute(pos.astype(np.float64), ids)
+
+
+def test_resolve_engine_journals_degradation():
+    from mpi_grid_redistribute_tpu import telemetry
+
+    rec = telemetry.StepRecorder()
+    out = exchange.resolve_engine(
+        "auto", canonical=True, planar_ok=False, recorder=rec
+    )
+    assert out == "rowmajor"
+    ev = rec.events("engine_resolved")
+    assert len(ev) == 1
+    assert ev[0].data["requested"] == "auto"
+    assert ev[0].data["resolved"] == "rowmajor"
+    assert "planar-eligible" in ev[0].data["reason"]
+    assert ev[0].data["canonical"] is True

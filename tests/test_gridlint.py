@@ -443,6 +443,57 @@ def test_g006_sees_nested_defs_in_marked_fn(tmp_path):
     assert rules_of(findings) == ["G006"], findings
 
 
+def test_g006_fires_on_subscript_iota_in_marked_fn(tmp_path):
+    # the exchange wire builders' idiom (ISSUE 7): a dense permutation
+    # spelled as advanced indexing — x[:, arange(n)] — must fire; the
+    # plan-shaped subscript and the unmarked dense engine stay quiet
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax.numpy as jnp
+    from jax import lax
+
+    # gridlint: fastpath-engine
+    def wire(pool, plan, n):
+        dense = pool[:, jnp.arange(n)]
+        narrow = pool[:, plan]
+        return dense, narrow
+
+    def dense_wire(pool, n):
+        return pool[:, jnp.arange(n)]
+    """,
+        },
+        rules=["G006"],
+    )
+    assert rules_of(findings) == ["G006"], findings
+    assert len(findings) == 1
+    assert "subscript" in findings[0].message
+    assert findings[0].symbol == "wire"
+
+
+def test_g006_exchange_wire_builders_are_marked_and_clean():
+    # the real count-driven wire builders carry the marker (the contract
+    # is opted into, not implied) and lint clean — the static half of
+    # the wire-cost contract; the jaxpr walks in
+    # tests/test_exchange_sparse.py hold the dynamic half
+    from mpi_grid_redistribute_tpu.analysis.rules_fastpath import (
+        _MARKER_RE,
+    )
+
+    path = os.path.join(PACKAGE, "parallel", "exchange.py")
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    marked = {
+        lines[i + 1].split("(")[0].replace("def ", "").strip()
+        for i, ln in enumerate(lines)
+        if _MARKER_RE.search(ln) and i + 1 < len(lines)
+    }
+    assert {"_sparse_wire", "_neighbor_wire"} <= marked, marked
+    findings = run_gridlint([path], root=REPO_ROOT, rules=["G006"])
+    assert findings == [], findings
+
+
 # ---------------------------------------------------------------- G007
 
 

@@ -367,10 +367,17 @@ def test_resolve_engine_matrix():
     assert r("planar", vranks=True, n_devices=1) == "planar"
     with pytest.raises(ValueError, match="canonical-exchange"):
         r("rowmajor", vranks=True, n_devices=1)
-    # canonical-exchange routing: sparse degrades to planar (MPI receive
-    # order forces a full repack anyway), rowmajor is the escape hatch
-    assert r("sparse", canonical=True) == "planar"
-    assert r("auto", canonical=True, planar_ok=True) == "planar"
+    with pytest.raises(ValueError, match="canonical-exchange"):
+        r("neighbor", vranks=True, n_devices=1)
+    # canonical-exchange routing (ISSUE 7): auto picks the count-driven
+    # sparse wire on multi-device meshes, planar on one device (no wire
+    # to shrink), rowmajor when the payload can't ride planar transport;
+    # sparse/neighbor are honored as asked — the dense pool is reachable
+    # only via explicit planar or the in-graph overflow fallback
+    assert r("sparse", canonical=True) == "sparse"
+    assert r("neighbor", canonical=True) == "neighbor"
+    assert r("auto", canonical=True, planar_ok=True, n_devices=8) == "sparse"
+    assert r("auto", canonical=True, planar_ok=True, n_devices=1) == "planar"
     assert r("auto", canonical=True, planar_ok=False) == "rowmajor"
     assert r("rowmajor", canonical=True) == "rowmajor"
     with pytest.raises(ValueError, match="engine must be one of"):
