@@ -1472,3 +1472,69 @@ def redistribute(
     """One-shot functional form of :class:`GridRedistribute`."""
     rd = GridRedistribute(domain, grid, backend=backend, **kwargs)
     return rd.redistribute(positions, *fields, count=count)
+
+
+def reshard(
+    positions,
+    *fields,
+    domain: Domain,
+    grid,
+    n_local: int,
+    backend: str = "numpy",
+    telemetry=None,
+    **kwargs,
+) -> RedistributeResult:
+    """Route UNPADDED live rows onto ``grid``'s owners in one canonical
+    redistribute — the elastic-restart entry point (ROADMAP item 3).
+
+    A snapshot written at R shards holds ``N`` live rows whose ownership
+    is derived from *position*, not from the shard that wrote them, so
+    re-decomposing onto an M-vrank grid is exactly one redistribute:
+    chunk the ``[N, ndim]`` live rows contiguously over M input shards
+    (any chunking works — the engine routes by position), then run the
+    canonical exchange into the ``[M * n_local, ...]`` padded global
+    layout. ``utils/checkpoint.py`` hints at this path ("load
+    everything, then redistribute once"); :mod:`.service.elastic` wraps
+    it for snapshot restores.
+
+    ``fields`` ride the same permutation (e.g. velocities and the id
+    column the service driver threads through for set-level restart
+    audits). Rows are only permuted, never recomputed, so per-particle
+    values are bit-identical across mesh shapes. Defaults to the numpy
+    backend: restores run host-side on whatever process survived, and
+    must not require the dead mesh to route the data off its shards.
+    Overflow heals by growing (``on_overflow="grow"``) — a reshard must
+    never drop rows, whatever the per-owner skew.
+    """
+    grid = grid if isinstance(grid, ProcessGrid) else ProcessGrid(grid)
+    positions = np.asarray(positions)
+    n = positions.shape[0]
+    m = grid.nranks
+    if int(n_local) < 1:
+        raise ValueError(f"n_local must be >= 1, got {n_local}")
+    in_rows = max(1, -(-n // m))  # ceil: every live row gets an input slot
+    fields = tuple(np.asarray(f) for f in fields)
+    pos_in = np.zeros((m * in_rows,) + positions.shape[1:], positions.dtype)
+    pos_in[:n] = positions
+    fields_in = []
+    for f in fields:
+        buf = np.zeros((m * in_rows,) + f.shape[1:], f.dtype)
+        buf[:n] = f
+        fields_in.append(buf)
+    # contiguous chunking: input shard c's live rows are exactly rows
+    # [c*in_rows, c*in_rows + count_in[c]) of the flat live array
+    count_in = np.clip(
+        n - in_rows * np.arange(m, dtype=np.int64), 0, in_rows
+    ).astype(np.int32)
+    rd = GridRedistribute(
+        domain,
+        grid,
+        backend=backend,
+        capacity=in_rows,
+        out_capacity=int(n_local),
+        on_overflow="grow",
+        **kwargs,
+    )
+    if telemetry is not None:
+        rd.telemetry = telemetry
+    return rd.redistribute(pos_in, *fields_in, count=count_in)

@@ -16,6 +16,13 @@ story depends on:
   the latest snapshot, and byte-compares the final state against an
   uninterrupted run — ``bit_identical_resume`` in the capture, gated by
   ``make soak``.
+* **Does recovery survive losing devices?** The elastic leg (ISSUE 8)
+  crashes mid-run AND reports only half the devices on restart
+  (:class:`~..service.faults.DeviceLossFault`): the supervisor must
+  shrink-restore the snapshot onto the smaller mesh (journaled
+  ``reshard``) and the final global particle SET, sorted by id, must be
+  bit-identical to the uninterrupted full-mesh run —
+  ``elastic_set_identical`` in the capture.
 
 The headline is ``soak_pps`` (sustained particles/s through the full
 service loop, snapshots on) — guarded by ``bench-check`` like any other
@@ -24,7 +31,8 @@ skipped).
 
 Env overrides: ``BENCH_SCALE`` (scales ``n_local``), ``BENCH_GRID``,
 ``BENCH_SOAK_N_LOCAL``, ``BENCH_SOAK_EVERY`` (snapshot cadence),
-``BENCH_SOAK_K`` (min-of-k samples).
+``BENCH_SOAK_K`` (min-of-k samples), ``BENCH_SOAK_STEPS`` (crash/elastic
+leg horizon — small values make ``make soak-smoke`` a CI-speed gate).
 """
 
 from __future__ import annotations
@@ -123,7 +131,9 @@ def run(n_local: int = None, reps: int = None) -> dict:
 
         # --- crash leg: one injected crash, supervised restore ---------
         n_small = max(256, n_local // 8)
-        crash_steps, crash_every, crash_at = 24, 6, 15
+        crash_steps = int(os.environ.get("BENCH_SOAK_STEPS", 24))
+        crash_every = max(2, crash_steps // 4)
+        crash_at = max(2, 5 * crash_steps // 8)
         ref = _make_driver(
             grid, backend, n_small, crash_steps, crash_every,
             os.path.join(root, "ref_snaps"),
@@ -150,6 +160,40 @@ def run(n_local: int = None, reps: int = None) -> dict:
                 for a, b in zip(ref.state, sup.driver.state)
             )
         )
+
+        # --- elastic leg: crash + device loss -> shrink-restore --------
+        from mpi_grid_redistribute_tpu.service import DeviceLossFault
+        from mpi_grid_redistribute_tpu.service import elastic as elastic_lib
+
+        rec2 = StepRecorder()
+        plan2 = FaultPlan(
+            [CrashFault(crash_at), DeviceLossFault(max(1, R // 2))]
+        )
+
+        def elastic_factory(grid_shape=None):
+            g = tuple(grid_shape) if grid_shape is not None else grid
+            return _make_driver(
+                g, backend, n_small, crash_steps, crash_every,
+                os.path.join(root, "elastic_snaps"), recorder=rec2,
+                faults=plan2,
+            )
+
+        sup2 = Supervisor(
+            elastic_factory,
+            policy=RestartPolicy(backoff_base_s=0.01, backoff_cap_s=0.05),
+            recorder=rec2,
+        )
+        verdict2 = sup2.run()
+        # mesh shapes differ, so compare the global particle SET (sorted
+        # by id), not the padded per-vrank layout
+        elastic_set_identical = bool(
+            verdict2.ok
+            and elastic_lib.particle_set(*ref.state)
+            == elastic_lib.particle_set(*sup2.driver.state)
+        )
+        resharded = len(rec2.events("reshard"))
+        elastic_grid = list(sup2.driver.cfg.grid_shape)
+        elastic_restarts = verdict2.restarts
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -169,13 +213,19 @@ def run(n_local: int = None, reps: int = None) -> dict:
         "snapshot_overhead": round(overhead, 4),
         "restarts": verdict.restarts,
         "bit_identical_resume": bit_identical,
+        "elastic_restarts": elastic_restarts,
+        "elastic_grid": elastic_grid,
+        "elastic_set_identical": elastic_set_identical,
+        "resharded": resharded,
     }
     common.log(
         f"config8: soak {live / soak['min']:.3e} pps "
         f"({soak['min'] * 1e3:.2f} ms/step, snapshots every {every}), "
         f"snapshot overhead {overhead * 100:+.2f}%, "
         f"crash leg: restarts={verdict.restarts} "
-        f"bit_identical={bit_identical}"
+        f"bit_identical={bit_identical}, "
+        f"elastic leg: grid {list(grid)}->{elastic_grid} "
+        f"resharded={resharded} set_identical={elastic_set_identical}"
     )
     return out
 
@@ -199,6 +249,21 @@ def _soak_gate(out: dict, overhead_max: float = 0.02) -> list:
         )
     if out["snapshots_written"] < 1:
         failures.append("soak run wrote no snapshots")
+    if not out["elastic_set_identical"]:
+        failures.append(
+            "shrink-restored particle set is NOT identical to the "
+            "uninterrupted full-mesh run"
+        )
+    if out["elastic_restarts"] != 1:
+        failures.append(
+            f"elastic leg restarted {out['elastic_restarts']} times, "
+            f"expected 1"
+        )
+    if out["resharded"] < 1:
+        failures.append(
+            "elastic leg journaled no reshard event (restore never "
+            "re-decomposed the snapshot)"
+        )
     return failures
 
 

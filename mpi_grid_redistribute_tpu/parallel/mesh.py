@@ -57,6 +57,44 @@ def near_cubic_shape(n: int, ndim: int = 3) -> Tuple[int, ...]:
     return tuple(sorted(shape, reverse=True))
 
 
+def shrink_shape(shape: Sequence[int]) -> Tuple[int, ...]:
+    """One elastic-restart shrink step: halve the largest axis.
+
+    The service supervisor's mesh-shrink policy and the device-loss
+    restore path both walk grid shapes DOWN this ladder — deterministic
+    (largest extent, lowest axis index on ties, ``extent // 2``), so a
+    journaled ``reshard`` event's old/new shapes are reproducible from
+    the policy alone. A shape that cannot shrink (all axes 1) is
+    returned unchanged; callers treat ``shrink_shape(s) == s`` as "no
+    smaller mesh exists".
+    """
+    shape = tuple(int(x) for x in shape)
+    if any(x < 1 for x in shape):
+        raise ValueError(f"grid shape must be positive, got {shape}")
+    if all(x == 1 for x in shape):
+        return shape
+    axis = max(range(len(shape)), key=lambda a: (shape[a], -a))
+    return shape[:axis] + (max(1, shape[axis] // 2),) + shape[axis + 1:]
+
+
+def shrink_to_fit(shape: Sequence[int], max_devices: int) -> Tuple[int, ...]:
+    """Smallest number of :func:`shrink_shape` steps that fits ``shape``
+    onto ``max_devices`` vranks — the restore-time answer to "the mesh
+    now reports M < R devices". Raises when even the 1-vrank grid does
+    not fit (``max_devices < 1``)."""
+    if max_devices < 1:
+        raise ValueError(
+            f"cannot fit a grid onto {max_devices} devices"
+        )
+    shape = tuple(int(x) for x in shape)
+    while math.prod(shape) > max_devices:
+        smaller = shrink_shape(shape)
+        if smaller == shape:  # unreachable: prod((1,..)) == 1 <= max
+            break
+        shape = smaller
+    return shape
+
+
 def initialize_distributed(**kwargs) -> None:
     """Multi-host bring-up: ``jax.distributed.initialize`` passthrough.
 

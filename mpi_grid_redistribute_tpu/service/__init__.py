@@ -4,10 +4,14 @@ The drift→redistribute loop as an always-on supervised service:
 
 * :mod:`.driver` — :class:`ServiceDriver`, the checkpointed streaming
   loop (snapshot cadence, journal export, watchdog, health-driven
-  engine degradation).
+  engine degradation, SLO-breach failures).
 * :mod:`.supervisor` — :class:`Supervisor` + :class:`RestartPolicy`,
-  restore-from-latest-valid-snapshot with bounded jittered backoff and
-  a crash-loop circuit breaker.
+  restore-from-latest-valid-snapshot with bounded jittered backoff, a
+  crash-loop circuit breaker, and the repeated-breach mesh-shrink
+  policy.
+* :mod:`.elastic` — :func:`reshard_state`, the one-shot canonical
+  redistribute that restores an R-shard snapshot onto an M-vrank grid
+  (ISSUE 8), plus the :func:`particle_set` bit-identity audit.
 * :mod:`.faults` — deterministic seeded fault injectors
   (:class:`FaultPlan`); every survivable failure mode has one.
 """
@@ -16,12 +20,18 @@ from mpi_grid_redistribute_tpu.service.driver import (
     DriverConfig,
     ServiceDriver,
 )
+from mpi_grid_redistribute_tpu.service.elastic import (
+    ElasticRestoreError,
+)
 from mpi_grid_redistribute_tpu.service.faults import (
     CrashFault,
+    DeviceLossFault,
     FallbackFloodFault,
     FaultPlan,
     InjectedCrash,
     JournalShardLossFault,
+    LatencySpikeFault,
+    SLOBreachError,
     StallError,
     StallFault,
     TornSnapshotFault,
@@ -34,12 +44,16 @@ from mpi_grid_redistribute_tpu.service.supervisor import (
 
 __all__ = [
     "CrashFault",
+    "DeviceLossFault",
     "DriverConfig",
+    "ElasticRestoreError",
     "FallbackFloodFault",
     "FaultPlan",
     "InjectedCrash",
     "JournalShardLossFault",
+    "LatencySpikeFault",
     "RestartPolicy",
+    "SLOBreachError",
     "ServiceDriver",
     "StallError",
     "StallFault",

@@ -81,6 +81,16 @@ class DriverConfig:
     watchdog_s: float = 0.0   # wall budget per step; 0 = watchdog off
     health_every: int = 0     # extra health cadence; 0 = at snapshots only
     step_sleep: float = 0.0   # pacing, so external kills land mid-run
+    # elastic restore (ISSUE 8): re-shard a snapshot whose (nranks,
+    # rows_per_shard) disagrees with this config onto the configured
+    # grid in one canonical redistribute; off = clear ElasticRestoreError
+    auto_reshard: bool = True
+    # SLO surface feeding the restart policy; each knob, when enabled,
+    # installs its ALERT rule and a breach raises SLOBreachError out of
+    # the run loop (restart; repeated breach = supervisor mesh shrink)
+    slo_latency_p99_s: float = 0.0   # p99 step-latency budget; 0 = off
+    slo_dropped_p99: int = -1        # p99 dropped-rows budget; -1 = off
+    slo_window: int = 16             # step_latency events per SLO window
 
 
 class ServiceDriver:
@@ -115,13 +125,36 @@ class ServiceDriver:
         self.engine = cfg.engine
         self.degraded = False
         self.step = 0
-        self.state: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self.state: Optional[Tuple[np.ndarray, ...]] = None
         self.journal_path: Optional[str] = None
         self._rd = None
         self._wall_ema: Optional[float] = None
+        self._last_dropped = 0
         self._writer: Optional[threading.Thread] = None
         self._writer_error: Optional[str] = None
         self._last_snapshot_path: Optional[str] = None
+        self._install_slo_rules()
+
+    def _install_slo_rules(self) -> None:
+        # the monitor is SHARED across supervisor restarts, so install
+        # by rule name, never append blindly (a restarted driver must
+        # not stack a second copy of each rule)
+        from mpi_grid_redistribute_tpu.telemetry import health as health_lib
+
+        cfg = self.cfg
+        have = {r.name for r in self.monitor.rules}
+        if cfg.slo_latency_p99_s > 0 and "slo_latency_p99" not in have:
+            self.monitor.rules.append(
+                health_lib.slo_latency_p99(
+                    cfg.slo_latency_p99_s, window=cfg.slo_window
+                )
+            )
+        if cfg.slo_dropped_p99 >= 0 and "slo_dropped_rows" not in have:
+            self.monitor.rules.append(
+                health_lib.slo_dropped_rows(
+                    cfg.slo_dropped_p99, window=cfg.slo_window
+                )
+            )
 
     # ---------------------------------------------------------- build
 
@@ -167,7 +200,11 @@ class ServiceDriver:
 
     def init_state(self) -> None:
         """Fresh seeded state: rows pre-placed on their owning shard
-        (slab-uniform), velocities sized for ``cfg.migration``."""
+        (slab-uniform), velocities sized for ``cfg.migration``. Every
+        row gets a stable int32 id (its initial global slot index) —
+        ids ride every redistribute as a passenger field, so the global
+        particle SET stays identifiable across restarts AND mesh
+        reshapes (the elastic bit-identity audits sort by id)."""
         from mpi_grid_redistribute_tpu.bench import common as bcommon
 
         cfg = self.cfg
@@ -178,30 +215,119 @@ class ServiceDriver:
         pos, vel, _ = bcommon.uniform_state(
             cfg.grid_shape, cfg.n_local, 1.0, rng, vel_scale=v_scale
         )
+        ids = np.arange(self.nranks * cfg.n_local, dtype=np.int32)
         count = np.full(
             (self.nranks,), int(cfg.fill * cfg.n_local), np.int32
         )
-        self.state = (pos, vel, count)
+        self.state = (pos, vel, ids, count)
         self.step = 0
 
-    def restore_latest(self) -> bool:
+    def restore_latest(self, grid_shape: Optional[Tuple[int, ...]] = None
+                       ) -> bool:
         """Restore from the newest VALID snapshot (corrupt ones are
         skipped and the skip count journaled). Returns False when no
         valid snapshot exists — the caller falls back to
-        :meth:`init_state`."""
+        :meth:`init_state`.
+
+        Elastic (ISSUE 8): ``grid_shape`` overrides the configured mesh
+        (the supervisor's shrink policy passes it), and the fault plan's
+        ``device_budget`` hook may report fewer surviving devices than
+        the target grid needs — the grid is then shrunk to fit
+        (:func:`..parallel.mesh.shrink_to_fit`). Whenever the snapshot's
+        ``(nranks, rows_per_shard)`` layout differs from the target, the
+        particle pytree is re-sharded onto the new grid in ONE canonical
+        redistribute (:func:`..service.elastic.reshard_state`), the
+        config is rewritten to the new mesh, and a ``reshard`` event
+        with old/new shapes and moved-row counts is journaled. With
+        ``cfg.auto_reshard`` off, any mismatch raises
+        :class:`~.elastic.ElasticRestoreError` naming both shapes
+        instead of failing deep in state unflattening."""
+        from mpi_grid_redistribute_tpu.service.elastic import (
+            ElasticRestoreError,
+        )
+
         cfg = self.cfg
         if not cfg.snapshot_dir:
             return False
         latest = checkpoint.load_latest(cfg.snapshot_dir)
         if latest is None:
             return False
-        a = latest.arrays
-        self.state = (
-            np.asarray(a["pos"], np.float32),
-            np.asarray(a["vel"], np.float32),
-            np.asarray(a["count"], np.int32),
+        a = dict(latest.arrays)
+        man = latest.manifest
+        snap_r = int(man["nranks"])
+        snap_rows = int(man["rows_per_shard"])
+        snap_grid = (man.get("extra") or {}).get("grid_shape")
+        snap_desc = (
+            f"grid {tuple(snap_grid)}" if snap_grid
+            else f"{snap_r} shards"
+        ) + f" x {snap_rows} rows"
+        if "ids" not in a:
+            # pre-elastic snapshot: synthesize stable slot-index ids
+            a["ids"] = np.arange(snap_r * snap_rows, dtype=np.int32)
+        target = tuple(
+            int(x) for x in (grid_shape or cfg.grid_shape)
         )
-        self.step = int(latest.manifest["step"])
+        budget = self.faults.device_budget(self)
+        if budget is not None:
+            from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+            fit = mesh_lib.shrink_to_fit(target, budget)
+            if fit != target and not cfg.auto_reshard:
+                raise ElasticRestoreError(
+                    f"snapshot {latest.path!r} ({snap_desc}) needs "
+                    f"{int(np.prod(target))} devices for grid {target}, "
+                    f"but the mesh reports only {budget} and "
+                    f"auto_reshard is disabled"
+                )
+            target = fit
+        same_layout = (
+            target == tuple(cfg.grid_shape)
+            and snap_r == self.nranks
+            and snap_rows == cfg.n_local
+        )
+        if same_layout:
+            self.state = (
+                np.asarray(a["pos"], np.float32),
+                np.asarray(a["vel"], np.float32),
+                np.asarray(a["ids"], np.int32),
+                np.asarray(a["count"], np.int32),
+            )
+        else:
+            if not cfg.auto_reshard:
+                raise ElasticRestoreError(
+                    f"snapshot {latest.path!r} ({snap_desc}) does not "
+                    f"match the configured grid {tuple(cfg.grid_shape)} "
+                    f"x {cfg.n_local} rows and auto_reshard is disabled"
+                )
+            from mpi_grid_redistribute_tpu.service.elastic import (
+                reshard_state,
+            )
+
+            res = reshard_state(a, man, target)
+            self.cfg = cfg = dataclasses.replace(
+                cfg, grid_shape=target, n_local=res.n_local
+            )
+            self._rd = None  # rebuilt on the new mesh at the next step
+            out = res.arrays
+            self.state = (
+                np.asarray(out["pos"], np.float32),
+                np.asarray(out["vel"], np.float32),
+                np.asarray(out["ids"], np.int32),
+                np.asarray(out["count"], np.int32),
+            )
+            self.recorder.record(
+                "reshard",
+                old_grid=list(snap_grid) if snap_grid else None,
+                old_shards=snap_r,
+                old_rows_per_shard=snap_rows,
+                new_grid=list(target),
+                new_rows_per_shard=res.n_local,
+                rows=res.live_rows,
+                moved=res.moved_rows,
+                step=int(man["step"]),
+                path=latest.path,
+            )
+        self.step = int(man["step"])
         self.recorder.record(
             "restore",
             what="state",
@@ -228,14 +354,18 @@ class ServiceDriver:
     def snapshot(self) -> str:
         """Write one snapshot of the full particle pytree; journal it."""
         cfg = self.cfg
-        pos, vel, count = self.state
+        pos, vel, ids, count = self.state
         step = self.step
         path = os.path.join(cfg.snapshot_dir, f"step_{step:08d}")
         # the state tuple is never mutated in place (_advance returns
         # fresh arrays), so the writer thread can serialize these exact
         # arrays without a defensive copy
-        arrays = {"pos": pos, "vel": vel, "count": count}
-        extra = {"seed": cfg.seed, "engine": self.engine}
+        arrays = {"pos": pos, "vel": vel, "ids": ids, "count": count}
+        extra = {
+            "seed": cfg.seed,
+            "engine": self.engine,
+            "grid_shape": list(cfg.grid_shape),
+        }
 
         def write() -> None:
             try:
@@ -302,27 +432,40 @@ class ServiceDriver:
 
     # ------------------------------------------------------------ run
 
-    def _advance(self, pos, vel, count):
+    def _advance(self, pos, vel, ids, count):
         cfg = self.cfg
         one = np.float32(1.0)
         pos = (pos + vel * np.float32(cfg.dt)) % one
         # float32 `%` can round a tiny negative up to exactly 1.0, which
         # is outside the periodic domain [0, 1)
         pos = np.where(pos >= one, pos - one, pos)
-        res = self._rd.redistribute(pos, vel, count=count)
+        res = self._rd.redistribute(pos, vel, ids, count=count)
+        st = res.stats
+        self._last_dropped = 0 if st is None else (
+            int(np.asarray(st.dropped_send).sum())
+            + int(np.asarray(st.dropped_recv).sum())
+        )
         return (
             np.asarray(res.positions),
             np.asarray(res.fields[0]),
+            np.asarray(res.fields[1], np.int32),
             np.asarray(res.count, np.int32),
         )
 
     def _health_check(self) -> dict:
+        from mpi_grid_redistribute_tpu.service.faults import SLOBreachError
+
         verdict = self.monitor.evaluate()
         if not self.degraded and self.engine != "planar":
             for f in verdict["findings"]:
                 if f["rule"] == "fast_path_fallback":
                     self._degrade(f["reason"])
                     break
+        for f in verdict["findings"]:
+            # an SLO breach is a FAILURE, not an advisory: raise out of
+            # the loop so the supervisor restarts (and shrinks on repeat)
+            if f["rule"].startswith("slo_"):
+                raise SLOBreachError(f"{f['rule']}: {f['reason']}")
         return verdict
 
     def _degrade(self, reason: str) -> None:
@@ -334,10 +477,25 @@ class ServiceDriver:
         self.degraded = True
         self._rd = None  # rebuilt with the pinned engine on next step
 
+    def snapshots_corrupt(self) -> int:
+        """Corrupt snapshots skipped over by restores, summed from the
+        retained ``restore`` events — the journal twin of the
+        ``grid_snapshot_corrupt_total`` counter the metrics plane
+        scrapes (it used to be counted by ``load_latest`` and then
+        dropped on the floor)."""
+        return sum(
+            int(e.data.get("snapshots_skipped", 0) or 0)
+            for e in self.recorder.events("restore")
+            if e.data.get("what") == "state"
+        )
+
     def healthz(self) -> Tuple[int, dict]:
         """The ``/healthz`` contract for the supervisor: read-only rule
-        evaluation, HTTP-style status code (503 on ALERT)."""
+        evaluation, HTTP-style status code (503 on ALERT). The verdict
+        carries ``snapshots_corrupt`` so a poller sees skipped-over
+        corruption without scraping the metrics plane."""
         verdict = self.monitor.evaluate(record=False)
+        verdict["snapshots_corrupt"] = self.snapshots_corrupt()
         return (503 if verdict["status"] == "ALERT" else 200), verdict
 
     def run(self, max_steps: Optional[int] = None):
@@ -358,6 +516,15 @@ class ServiceDriver:
             wall = time.perf_counter() - t0
             self.step += 1
             self.monitor.note_step_time(wall)
+            # the SLO surface: one step_latency event per step feeds the
+            # grid_step_latency_seconds / grid_dropped_rows histograms
+            # and the slo_* window rules (telemetry/SCHEMA.md)
+            self.recorder.record(
+                "step_latency",
+                step=self.step,
+                seconds=float(wall),
+                dropped=self._last_dropped,
+            )
             self._wall_ema = (
                 wall if self._wall_ema is None
                 else 0.2 * wall + 0.8 * self._wall_ema
@@ -454,6 +621,19 @@ def main(argv=None) -> int:
     p.add_argument("--backoff-base", type=float, default=0.05)
     p.add_argument("--backoff-cap", type=float, default=2.0)
     p.add_argument(
+        "--slo-p99", type=float, default=0.0, metavar="SECONDS",
+        help="p99 step-latency SLO; sustained breach restarts (0 = off)",
+    )
+    p.add_argument(
+        "--no-reshard", action="store_true",
+        help="disable elastic restore (mesh-mismatched snapshots error)",
+    )
+    p.add_argument(
+        "--shrink-after", type=int, default=0, metavar="N",
+        help="supervise mode: shrink the mesh after N consecutive "
+             "SLO-breach restarts (0 = never)",
+    )
+    p.add_argument(
         "--inject-crash", type=int, default=None, metavar="STEP",
         help="inject a crash at STEP (-1 = every run: crash-loop)",
     )
@@ -485,6 +665,8 @@ def main(argv=None) -> int:
         journal_dir=args.journal_dir,
         watchdog_s=args.watchdog,
         step_sleep=args.step_sleep,
+        auto_reshard=not args.no_reshard,
+        slo_latency_p99_s=args.slo_p99,
     )
     faults = FaultPlan()
     if args.inject_crash is not None:
@@ -500,13 +682,21 @@ def main(argv=None) -> int:
         )
 
         recorder = StepRecorder()
+
+        def factory(grid_shape=None):
+            c = cfg
+            if grid_shape is not None:
+                c = dataclasses.replace(c, grid_shape=tuple(grid_shape))
+            return ServiceDriver(c, recorder=recorder, faults=faults)
+
         sup = Supervisor(
-            lambda: ServiceDriver(cfg, recorder=recorder, faults=faults),
+            factory,
             policy=RestartPolicy(
                 max_restarts=args.max_restarts,
                 window_s=args.window_s,
                 backoff_base_s=args.backoff_base,
                 backoff_cap_s=args.backoff_cap,
+                shrink_after=args.shrink_after,
             ),
             recorder=recorder,
         )
@@ -515,9 +705,9 @@ def main(argv=None) -> int:
         if args.final_out and sup.driver is not None and (
             sup.driver.state is not None
         ):
-            pos, vel, count = sup.driver.state
+            pos, vel, ids, count = sup.driver.state
             np.savez(
-                args.final_out, pos=pos, vel=vel, count=count,
+                args.final_out, pos=pos, vel=vel, ids=ids, count=count,
                 step=sup.driver.step,
             )
         return 0 if verdict.ok else 3
@@ -528,9 +718,10 @@ def main(argv=None) -> int:
     drv.run()
     drv.close()
     if args.final_out:
-        pos, vel, count = drv.state
+        pos, vel, ids, count = drv.state
         np.savez(
-            args.final_out, pos=pos, vel=vel, count=count, step=drv.step
+            args.final_out, pos=pos, vel=vel, ids=ids, count=count,
+            step=drv.step,
         )
     print(
         json.dumps(

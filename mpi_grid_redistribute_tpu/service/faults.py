@@ -32,6 +32,15 @@ The five injectors (one per tentpole failure mode):
   ``fast_path`` events until the ``fast_path_fallback`` health rule
   fires and the driver degrades ``engine -> planar`` (one-way, no
   flapping).
+
+ISSUE 8 adds the elastic pair:
+
+* :class:`LatencySpikeFault` — journal synthetic slow ``step_latency``
+  events until the ``slo_latency_p99`` rule breaches and the driver
+  raises :class:`SLOBreachError` (restart, then shrink on repeat).
+* :class:`DeviceLossFault` — answer the driver's restore-time
+  ``device_budget`` query with M < R survivors, forcing a shrink-to-fit
+  re-shard of the snapshot (journaled ``reshard``).
 """
 # gridlint: service-path
 
@@ -51,6 +60,13 @@ class InjectedCrash(RuntimeError):
 class StallError(RuntimeError):
     """A step exceeded the driver's watchdog budget (stalled step is a
     failure, not a wait — the supervisor restarts from snapshot)."""
+
+
+class SLOBreachError(RuntimeError):
+    """The driver's health check found a sustained SLO breach (p99
+    step-latency or dropped-rows over the configured window). Raised out
+    of the run loop so the supervisor treats it as a restartable failure
+    — and, on repeat, as the trigger for a mesh shrink."""
 
 
 class CrashFault:
@@ -207,6 +223,76 @@ class FallbackFloodFault:
         )
 
 
+class LatencySpikeFault:
+    """Journal synthetic slow ``step_latency`` events (``seconds`` each)
+    from ``start_step`` until a budget of ``spikes`` is spent — the
+    signature of a mesh limping along (straggler device, contended
+    host). The ``slo_latency_p99`` health rule must see the window p99
+    blow through the SLO and raise :class:`SLOBreachError`; the
+    supervisor restarts, and on repeated breach shrinks the mesh. The
+    finite budget means the fault eventually clears, so the run proves
+    recovery as well as detection."""
+
+    kind = "latency_spike"
+
+    def __init__(self, start_step: int, seconds: float = 1.0,
+                 spikes: int = 8):
+        self.start_step = int(start_step)
+        self.seconds = float(seconds)
+        self.spikes = int(spikes)
+        self.fired = False
+        self._left = int(spikes)
+
+    def before_step(self, driver) -> None:
+        if self._left <= 0 or driver.step < self.start_step:
+            return
+        if not self.fired:
+            self.fired = True
+            driver.recorder.record(
+                "fault_injected", fault=self.kind, step=driver.step,
+                seconds=self.seconds, spikes=self.spikes,
+            )
+        self._left -= 1
+        driver.recorder.record(
+            "step_latency", step=driver.step, seconds=self.seconds,
+            dropped=0,
+        )
+
+
+class DeviceLossFault:
+    """On restart, the mesh reports only ``devices`` survivors (M < R).
+
+    Consulted via the :meth:`device_budget` hook rather than a step
+    hook: ``ServiceDriver.restore_latest`` asks the plan for a device
+    budget before building its grid, and this injector answers with
+    ``devices`` once the journal shows at least ``after_restarts``
+    supervisor restarts — i.e. the device died WITH the crash, and every
+    restore after it sees the smaller mesh. The driver must then
+    shrink-to-fit the grid and re-shard the snapshot (journaled
+    ``reshard``) instead of failing on the shape mismatch."""
+
+    kind = "device_loss"
+
+    def __init__(self, devices: int, after_restarts: int = 1):
+        if devices < 1:
+            raise ValueError(f"devices must be >= 1, got {devices}")
+        self.devices = int(devices)
+        self.after_restarts = int(after_restarts)
+        self.fired = False
+
+    def device_budget(self, driver) -> Optional[int]:
+        counts = driver.recorder.counts()
+        if counts.get("restart", 0) < self.after_restarts:
+            return None
+        if not self.fired:
+            self.fired = True
+            driver.recorder.record(
+                "fault_injected", fault=self.kind, step=driver.step,
+                devices=self.devices,
+            )
+        return self.devices
+
+
 class FaultPlan:
     """An ordered bag of injectors the driver consults at its hooks."""
 
@@ -227,6 +313,19 @@ class FaultPlan:
             hook = getattr(f, "after_snapshot", None)
             if hook is not None:
                 hook(driver, path)
+
+    def device_budget(self, driver) -> Optional[int]:
+        """Surviving-device count the mesh would report at restore time:
+        the tightest answer across injectors (``None`` = full mesh)."""
+        budget: Optional[int] = None
+        for f in self.faults:
+            hook = getattr(f, "device_budget", None)
+            if hook is None:
+                continue
+            b = hook(driver)
+            if b is not None and (budget is None or b < budget):
+                budget = b
+        return budget
 
     @classmethod
     def seeded(
@@ -262,6 +361,10 @@ class FaultPlan:
                 faults.append(JournalShardLossFault(at))
             elif kind == "fallback_flood":
                 faults.append(FallbackFloodFault(at))
+            elif kind == "latency_spike":
+                faults.append(LatencySpikeFault(at))
+            elif kind == "device_loss":
+                faults.append(DeviceLossFault(1))
             else:
                 raise ValueError(f"unknown fault kind {kind!r}")
         return cls(faults)

@@ -14,7 +14,12 @@ ones), runs it, and decides what a failure means:
 * too many restarts inside a sliding window → the crash-loop circuit
   breaker trips and the supervisor gives up with an explicit verdict
   (``gave_up=True``; CLI exit code 3), instead of burning the machine
-  retrying a deterministic failure forever.
+  retrying a deterministic failure forever;
+* ``shrink_after`` consecutive :class:`~.faults.SLOBreachError`
+  failures → the mesh itself cannot hold the SLO: the next attempt is
+  built on :func:`~..parallel.mesh.shrink_shape` of the current grid
+  (journaled ``restart`` with ``action="shrink"``), and the driver's
+  elastic restore re-shards the snapshot onto it (ISSUE 8).
 
 Between restarts it sleeps a bounded exponential backoff with seeded
 jitter (deterministic in tests via ``sleep_fn``/``clock`` injection).
@@ -45,6 +50,12 @@ class RestartPolicy:
     backoff_cap_s: float = 2.0
     jitter: float = 0.25       # backoff *= 1 + jitter*U[0,1)
     seed: int = 0              # jitter stream (deterministic schedules)
+    # mesh-shrink policy (ISSUE 8): after this many CONSECUTIVE
+    # SLO-breach failures, restart onto shrink_shape(grid) — the mesh
+    # cannot hold the SLO, so stop thrashing restarts and re-shard onto
+    # fewer vranks. 0 = never; needs a driver_factory accepting an
+    # optional grid_shape kwarg.
+    shrink_after: int = 0
 
     def backoff_s(self, attempt: int, rng: np.random.Generator) -> float:
         base = min(
@@ -102,8 +113,13 @@ class Supervisor:
         rng = np.random.default_rng(policy.seed)
         restart_times: List[float] = []
         attempt = 0
+        breaches = 0          # CONSECUTIVE SLO-breach failures
+        grid_override = None  # set once the shrink policy fires
         while True:
-            driver = self.driver_factory()
+            if grid_override is None:
+                driver = self.driver_factory()
+            else:
+                driver = self.driver_factory(grid_shape=grid_override)
             self.driver = driver
             if self._recorder is None:
                 self._recorder = driver.recorder
@@ -134,6 +150,13 @@ class Supervisor:
                         reason="", step=driver.step,
                         health=verdict["status"],
                     )
+            # SLOBreachError failures feed the shrink policy; any other
+            # failure mode resets the consecutive-breach count (a crash
+            # between breaches is not evidence the MESH is too slow)
+            if "SLOBreachError" in failure:
+                breaches += 1
+            else:
+                breaches = 0
             now = self.clock()
             restart_times = [
                 t for t in restart_times if now - t <= policy.window_s
@@ -153,6 +176,21 @@ class Supervisor:
                     reason=reason, step=driver.step,
                     health=verdict["status"],
                 )
+            if policy.shrink_after and breaches >= policy.shrink_after:
+                from mpi_grid_redistribute_tpu.parallel import (
+                    mesh as mesh_lib,
+                )
+
+                old = tuple(driver.cfg.grid_shape)
+                new = mesh_lib.shrink_shape(old)
+                if new != old:
+                    self.recorder.record(
+                        "restart", action="shrink", attempt=attempt,
+                        reason=failure, old_grid=list(old),
+                        new_grid=list(new), step=driver.step,
+                    )
+                    grid_override = new
+                    breaches = 0
             backoff = policy.backoff_s(attempt, rng)
             self.recorder.record(
                 "restart", action="restart", attempt=attempt,

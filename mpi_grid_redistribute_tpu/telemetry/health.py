@@ -38,6 +38,14 @@ The stock rules (:func:`default_rules`):
   exceeds ``factor`` x its recorded cadence: the service driver's
   checkpoint writer has stalled or died, so a crash now loses more work
   than the restart policy budgets for. WARN.
+
+Opt-in SLO rules (installed by the service driver when its SLO knobs
+are set; they actuate the restart/shrink policy, ISSUE 8):
+
+* ``slo_latency_p99`` — bucketed p99 of the last ``window``
+  ``step_latency`` events above the latency SLO. ALERT.
+* ``slo_dropped_rows`` — bucketed p99 of per-step dropped rows above
+  the loss SLO (default 0: any sustained loss). ALERT.
 """
 
 from __future__ import annotations
@@ -226,6 +234,74 @@ def snapshot_staleness(factor: float = 2.0) -> HealthRule:
         return None
 
     return HealthRule("snapshot_staleness", WARN, fn)
+
+
+def slo_latency_p99(
+    threshold_s: float, window: int = 16, q: float = 0.99
+) -> HealthRule:
+    """ALERT when the bucketed ``q``-quantile of the last ``window``
+    ``step_latency`` events exceeds ``threshold_s``.
+
+    The quantile is computed through the same pow2-bucket histogram the
+    metrics plane scrapes (``grid_step_latency_seconds``), so the value
+    that trips the restart policy is the value an operator sees on
+    ``/metrics`` — not a slightly different exact-percentile. Needs a
+    full window before it can fire (a cold journal is not a breach), so
+    a post-restart driver gets ``window`` healthy steps to prove itself
+    before old spikes scroll out."""
+    if threshold_s <= 0:
+        raise ValueError(f"threshold_s must be > 0, got {threshold_s}")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        ev = rec.events("step_latency")[-window:]
+        if len(ev) < window:
+            return None
+        h = metrics_lib.Histogram((), metrics_lib.STEP_TIME_EDGES)
+        for e in ev:
+            h.observe(float(e.data.get("seconds", 0.0)))
+        p = h.quantile(q)
+        if p > threshold_s:
+            return (
+                f"step latency p{q * 100:g} over the last {window} steps"
+                f" is {p:.3g}s (> {threshold_s:.3g}s SLO)"
+            )
+        return None
+
+    return HealthRule("slo_latency_p99", ALERT, fn)
+
+
+def slo_dropped_rows(
+    threshold: int = 0, window: int = 16, q: float = 0.99
+) -> HealthRule:
+    """ALERT when the bucketed ``q``-quantile of rows dropped per step
+    over the last ``window`` ``step_latency`` events exceeds
+    ``threshold`` — the ``grid_dropped_rows`` histogram's SLO twin of
+    :func:`slo_latency_p99` (default 0: any sustained loss breaches)."""
+    if threshold < 0:
+        raise ValueError(f"threshold must be >= 0, got {threshold}")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        ev = rec.events("step_latency")[-window:]
+        if len(ev) < window:
+            return None
+        h = metrics_lib.Histogram((), metrics_lib.DROPPED_EDGES)
+        for e in ev:
+            h.observe(int(e.data.get("dropped", 0)))
+        p = h.quantile(q)
+        if p > threshold:
+            return (
+                f"dropped rows p{q * 100:g} over the last {window} steps"
+                f" is {p:g} (> {threshold} SLO)"
+            )
+        return None
+
+    return HealthRule("slo_dropped_rows", ALERT, fn)
 
 
 def default_rules() -> List[HealthRule]:

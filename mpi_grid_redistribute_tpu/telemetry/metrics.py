@@ -184,6 +184,28 @@ class Histogram(_Child):
         out.append((math.inf, self._count))
         return out
 
+    def quantile(self, q: float) -> float:
+        """Bucketed upper-bound ``q``-quantile: the smallest edge whose
+        cumulative count covers ``ceil(q * count)`` observations.
+
+        This is the estimate a Prometheus ``histogram_quantile`` over
+        the rendered buckets would bound, so an SLO rule computed here
+        (health.py ``slo_latency_p99``) agrees with what an operator
+        sees on ``/metrics``. Returns ``+Inf`` when the quantile lands
+        in the overflow bucket and ``0.0`` on an empty histogram.
+        """
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile q must be in (0, 1], got {q}")
+        if self._count == 0:
+            return 0.0
+        target = max(1, math.ceil(q * self._count))
+        acc = 0
+        for edge, n in zip(self._edges, self._bucket_counts):
+            acc += n
+            if acc >= target:
+                return edge
+        return math.inf
+
 
 _CHILD_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
@@ -357,6 +379,10 @@ def render_openmetrics(registry: MetricsRegistry) -> str:
 STEP_TIME_EDGES = pow2_edges(-14, 4)
 # mover counts: 1 .. 2^24 (~16.7M rows/step)
 MOVERS_EDGES = pow2_edges(0, 24)
+# dropped rows per step: an explicit 0 bucket (loss-free steps must be
+# distinguishable from <=1-row loss, and the p99-of-zeros must be 0 for
+# the threshold=0 SLO), then 1 .. 2^24 (same span as movers)
+DROPPED_EDGES = (0.0,) + pow2_edges(0, 24)
 
 
 def _iter_events(source) -> Tuple[Iterable[tuple], Optional[Dict[str, int]]]:
@@ -418,7 +444,10 @@ def from_journal(
       exchange wire bytes per engine over the journaled
       ``redistribute`` window;
     * ``alerts_total{rule,severity}`` — health findings journaled;
-    * ``flow_moved_rows`` / ``flow_imbalance`` — latest flow snapshot.
+    * ``flow_moved_rows`` / ``flow_imbalance`` — latest flow snapshot;
+    * ``step_latency_seconds`` / ``dropped_rows`` — pow2 histograms of
+      the service driver's ``step_latency`` events (the SLO surface);
+    * ``snapshot_corrupt_total`` — corrupt snapshots skipped at restore.
     """
     reg = registry if registry is not None else MetricsRegistry()
     events, counts = _iter_events(source)
@@ -458,6 +487,23 @@ def from_journal(
         f"{p}_step_time_seconds",
         "Measured wall step times (pow2 buckets)",
         edges=STEP_TIME_EDGES,
+    )
+    lat_h = reg.histogram(
+        f"{p}_step_latency_seconds",
+        "Service-driver end-to-end step latency (step_latency events,"
+        " pow2 buckets) — the SLO surface the restart policy actuates on",
+        edges=STEP_TIME_EDGES,
+    )
+    drop_h = reg.histogram(
+        f"{p}_dropped_rows",
+        "Rows dropped per service step (step_latency events, pow2"
+        " buckets); any nonzero sample is row loss",
+        edges=DROPPED_EDGES,
+    )
+    corrupt_c = reg.counter(
+        f"{p}_snapshot_corrupt",
+        "Corrupt snapshots skipped over during restores (restore"
+        " events' snapshots_skipped)",
     )
     fp_total = reg.counter(
         f"{p}_fast_path_steps",
@@ -510,6 +556,12 @@ def from_journal(
         elif kind == "step_time":
             if "seconds" in data:
                 st_h.labels().observe(float(data["seconds"]))
+        elif kind == "step_latency":
+            if "seconds" in data:
+                lat_h.labels().observe(float(data["seconds"]))
+            drop_h.labels().observe(int(data.get("dropped", 0)))
+        elif kind == "restore":
+            corrupt_c.labels().inc(int(data.get("snapshots_skipped", 0) or 0))
         elif kind == "fast_path":
             fp_total.labels(taken=int(data.get("taken", 0))).inc()
             if "movers" in data:

@@ -241,6 +241,51 @@ def load(
     }, manifest
 
 
+def gather_live(
+    arrays: Dict[str, np.ndarray],
+    nranks: int,
+    rows_per_shard: int,
+    count_key: str = "count",
+) -> Dict[str, np.ndarray]:
+    """Strip padding from a loaded snapshot: concatenate each shard's
+    first ``count[r]`` rows, dropping the dead tail slots.
+
+    The elastic-restore first half: a snapshot's global layout is only
+    meaningful at its own ``(nranks, rows_per_shard)``; the live rows are
+    mesh-independent. Returns every global array reduced to ``[N, ...]``
+    live rows (same relative order as on disk) plus ``count_key`` mapped
+    to the scalar total — ready for :func:`..api.reshard` onto any grid.
+    """
+    count = np.asarray(arrays[count_key]).astype(np.int64).ravel()
+    if count.shape != (nranks,):
+        raise ValueError(
+            f"count array {count.shape} does not match {nranks} shards"
+        )
+    if count.min() < 0 or count.max() > rows_per_shard:
+        raise ValueError(
+            f"count outside [0, {rows_per_shard}]: {count.tolist()}"
+        )
+    idx = np.concatenate(
+        [
+            np.arange(r * rows_per_shard, r * rows_per_shard + count[r])
+            for r in range(nranks)
+        ]
+    ) if nranks else np.zeros((0,), dtype=np.int64)
+    live: Dict[str, np.ndarray] = {}
+    for name, a in arrays.items():
+        if name == count_key:
+            live[name] = np.asarray(count.sum(), dtype=np.int64)
+            continue
+        a = np.asarray(a)
+        if a.shape[0] != nranks * rows_per_shard:
+            raise ValueError(
+                f"array {name!r} leading dim {a.shape[0]} is not the "
+                f"global layout {nranks}*{rows_per_shard}"
+            )
+        live[name] = a[idx]
+    return live
+
+
 def list_snapshots(root: str) -> List[str]:
     """Candidate snapshot directories under ``root``, newest first.
 

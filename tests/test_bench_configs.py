@@ -83,6 +83,7 @@ def test_config8_soak(monkeypatch):
     from mpi_grid_redistribute_tpu.bench import config8_soak
 
     monkeypatch.setenv("BENCH_SOAK_EVERY", "4")  # short cadence, short run
+    monkeypatch.setenv("BENCH_SOAK_STEPS", "12")  # short crash/elastic legs
     out = config8_soak.run(n_local=512, reps=2)
     assert out["metric"] == "soak_pps"
     assert out["value"] > 0
@@ -94,8 +95,16 @@ def test_config8_soak(monkeypatch):
     # scale by `make soak` / bench-check, not at this smoke size)
     assert out["restarts"] == 1
     assert out["bit_identical_resume"] is True
+    # the elastic leg: crash + half the devices lost -> shrink-restore,
+    # journaled reshard, and the id-sorted particle set preserved
+    assert out["elastic_restarts"] == 1
+    assert out["resharded"] == 1
+    assert out["elastic_grid"] != out["grid"]
+    assert out["elastic_set_identical"] is True
     # the gate helper agrees with a green capture when overhead passes
     ok = dict(out, snapshot_overhead=0.0)
     assert config8_soak._soak_gate(ok) == []
     bad = dict(out, bit_identical_resume=False)
     assert config8_soak._soak_gate(bad) != []
+    bad2 = dict(ok, elastic_set_identical=False)
+    assert config8_soak._soak_gate(bad2) != []

@@ -643,7 +643,7 @@ def test_g008_service_subsystem_is_marked_and_clean():
     from mpi_grid_redistribute_tpu.analysis.rules_service import _MARKER_RE
 
     svc = os.path.join(PACKAGE, "service")
-    for name in ("driver.py", "supervisor.py", "faults.py"):
+    for name in ("driver.py", "supervisor.py", "faults.py", "elastic.py"):
         with open(os.path.join(svc, name), encoding="utf-8") as fh:
             src = fh.read()
         assert _MARKER_RE.search(src), f"{name} lost its service-path marker"

@@ -86,3 +86,26 @@ def test_initialize_distributed_single_process():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "distributed-init-ok 8" in out.stdout
+
+
+# ----------------------------------------------- elastic shrink (ISSUE 8)
+
+
+def test_shrink_shape_halves_largest_axis():
+    assert mesh_lib.shrink_shape((2, 2, 2)) == (1, 2, 2)  # tie: lowest axis
+    assert mesh_lib.shrink_shape((1, 2, 2)) == (1, 1, 2)
+    assert mesh_lib.shrink_shape((1, 1, 2)) == (1, 1, 1)
+    assert mesh_lib.shrink_shape((2, 4, 2)) == (2, 2, 2)
+    assert mesh_lib.shrink_shape((1, 8)) == (1, 4)
+    # the floor: an all-ones grid cannot shrink and is returned unchanged
+    assert mesh_lib.shrink_shape((1, 1, 1)) == (1, 1, 1)
+
+
+def test_shrink_to_fit_walks_the_shrink_ladder():
+    assert mesh_lib.shrink_to_fit((2, 2, 2), 8) == (2, 2, 2)  # already fits
+    assert mesh_lib.shrink_to_fit((2, 2, 2), 4) == (1, 2, 2)
+    assert mesh_lib.shrink_to_fit((2, 2, 2), 3) == (1, 1, 2)
+    assert mesh_lib.shrink_to_fit((2, 2, 2), 1) == (1, 1, 1)
+    assert mesh_lib.shrink_to_fit((4, 4), 5) == (2, 2)
+    with pytest.raises(ValueError, match="cannot fit"):
+        mesh_lib.shrink_to_fit((2, 2, 2), 0)

@@ -98,6 +98,36 @@ def test_histogram_bucket_hand_math():
     assert child.sum == pytest.approx(114.5)
 
 
+def test_histogram_quantile_hand_math():
+    h = metrics_lib.Histogram((), pow2_edges(0, 3))  # edges 1,2,4,8
+    with pytest.raises(ValueError, match="quantile"):
+        h.quantile(0.0)
+    with pytest.raises(ValueError, match="quantile"):
+        h.quantile(1.5)
+    assert h.quantile(0.99) == 0.0  # empty histogram, not an error
+    for v in (0.5, 1.0, 3.0, 8.0):
+        h.observe(v)
+    # bucketed UPPER bound: smallest edge covering ceil(q * count)
+    assert h.quantile(0.5) == 1.0   # target 2 of 4 -> le=1 bucket (2)
+    assert h.quantile(0.75) == 4.0  # target 3 -> le=4 bucket
+    assert h.quantile(1.0) == 8.0
+    h.observe(100.0)  # overflow bucket
+    assert h.quantile(1.0) == math.inf
+    assert h.quantile(0.8) == 8.0   # target 4 of 5 still inside edges
+
+
+def test_dropped_edges_zero_bucket_keeps_p99_of_zeros_zero():
+    # grid_dropped_rows carries an explicit 0 edge: a loss-free window's
+    # p99 must be 0, not 1, or the threshold=0 SLO would always breach
+    assert metrics_lib.DROPPED_EDGES[0] == 0.0
+    h = metrics_lib.Histogram((), metrics_lib.DROPPED_EDGES)
+    for _ in range(100):
+        h.observe(0)
+    assert h.quantile(0.99) == 0.0
+    h.observe(3)  # a single lossy step is visible at the tail
+    assert h.quantile(1.0) == 4.0
+
+
 def test_family_shape_and_label_validation():
     reg = MetricsRegistry()
     c = reg.counter("ops", "ops", labelnames=("kind",))
@@ -169,6 +199,31 @@ def test_from_journal_hand_math():
     # 0.004 and 0.006 both exceed 2^-8 s, land in the le=2^-7 s bucket
     cum = dict(st.cumulative())
     assert cum[2.0 ** -8] == 0 and cum[2.0 ** -7] == 2
+
+
+def test_from_journal_service_slo_families():
+    # the ISSUE 8 SLO surface: step_latency events feed both histograms,
+    # restore events feed the corrupt-snapshot counter
+    rec = StepRecorder(host="h0", pid=7)
+    rec.record("step_latency", step=1, seconds=0.004, dropped=0)
+    rec.record("step_latency", step=2, seconds=0.006, dropped=5)
+    rec.record("restore", what="state", step=4, path="p",
+               snapshots_skipped=2)
+    rec.record("restore", what="journal", path="p")  # no skip field: +0
+    reg = from_journal(rec)
+
+    lat = reg.get("grid_step_latency_seconds").labels()
+    assert lat.count == 2 and lat.sum == pytest.approx(0.010)
+    drop = reg.get("grid_dropped_rows").labels()
+    assert drop.count == 2
+    assert dict(drop.cumulative())[0.0] == 1  # loss-free step visible
+    assert drop.quantile(1.0) == 8.0          # the 5-row step's bucket
+    assert reg.get("grid_snapshot_corrupt").labels().value == 2
+
+    text = reg.render_openmetrics()
+    assert 'grid_dropped_rows_bucket{le="0"} 1' in text
+    assert "grid_snapshot_corrupt_total 2" in text
+    assert "grid_step_latency_seconds_count 2" in text
 
 
 def test_journal_counters_exact_after_ring_eviction():

@@ -18,16 +18,21 @@ import pytest
 
 from mpi_grid_redistribute_tpu.service import (
     CrashFault,
+    DeviceLossFault,
     DriverConfig,
+    ElasticRestoreError,
     FallbackFloodFault,
     FaultPlan,
+    InjectedCrash,
     JournalShardLossFault,
+    LatencySpikeFault,
     RestartPolicy,
     ServiceDriver,
     StallFault,
     Supervisor,
     TornSnapshotFault,
 )
+from mpi_grid_redistribute_tpu.service import elastic
 from mpi_grid_redistribute_tpu.telemetry import StepRecorder
 from mpi_grid_redistribute_tpu.telemetry import health
 from mpi_grid_redistribute_tpu.utils import checkpoint
@@ -63,7 +68,7 @@ def _reference_state(cfg):
 
 
 def _assert_bit_identical(a, b):
-    for name, x, y in zip(("pos", "vel", "count"), a, b):
+    for name, x, y in zip(("pos", "vel", "ids", "count"), a, b):
         assert x.tobytes() == y.tobytes(), f"{name} diverged"
 
 
@@ -109,13 +114,22 @@ def test_restore_latest_without_snapshots(tmp_path):
 # ------------------------------------------------------- fault matrix
 
 
-def _supervised(tmp_path, cfg, faults, max_restarts=5):
+def _supervised(tmp_path, cfg, faults, max_restarts=5, **policy_kw):
     rec = StepRecorder()
+
+    def factory(grid_shape=None):
+        # the supervisor's shrink policy restarts onto a smaller grid by
+        # passing grid_shape; a plain restart keeps the configured one
+        c = cfg
+        if grid_shape is not None:
+            c = dataclasses.replace(c, grid_shape=tuple(grid_shape))
+        return ServiceDriver(c, recorder=rec, faults=faults)
+
     sup = Supervisor(
-        lambda: ServiceDriver(cfg, recorder=rec, faults=faults),
+        factory,
         policy=RestartPolicy(
             max_restarts=max_restarts, backoff_base_s=0.01,
-            backoff_cap_s=0.02,
+            backoff_cap_s=0.02, **policy_kw,
         ),
         recorder=rec,
         sleep_fn=lambda s: None,
@@ -235,6 +249,237 @@ def test_healthz_alert_forces_restart(tmp_path):
         e for e in rec.events("restart") if e.data["action"] == "restart"
     ]
     assert all("healthz 503" in e.data["reason"] for e in restart)
+
+
+# ------------------------------------------- elastic restore (ISSUE 8)
+
+
+def test_device_loss_shrink_restore_preserves_particle_set(tmp_path):
+    # crash at step 9, and every restore after the crash sees only 4 of
+    # the 8 devices: the driver must shrink-to-fit (2,2,2)->(1,2,2),
+    # re-shard the snapshot, and finish with the SAME global particles
+    cfg = _cfg(tmp_path)
+    plan = FaultPlan([CrashFault(9), DeviceLossFault(4)])
+    sup, rec = _supervised(tmp_path, cfg, plan)
+    verdict = sup.run()
+
+    assert verdict.ok is True, verdict
+    assert verdict.restarts == 1
+    assert verdict.step == cfg.steps
+    assert tuple(sup.driver.cfg.grid_shape) == (1, 2, 2)
+    # capacity preserved: half the vranks, double the padded rows
+    assert sup.driver.cfg.n_local == 512
+    assert rec.counts().get("fault_injected") == 2
+
+    (ev,) = rec.events("reshard")
+    assert ev.data["old_grid"] == [2, 2, 2]
+    assert ev.data["old_shards"] == 8
+    assert ev.data["old_rows_per_shard"] == 256
+    assert ev.data["new_grid"] == [1, 2, 2]
+    assert ev.data["new_rows_per_shard"] == 512
+    assert ev.data["step"] == 8  # resharded the step-8 snapshot
+    assert 0 < ev.data["moved"] <= ev.data["rows"]
+
+    # mesh shapes differ, so compare the id-sorted global particle SET
+    # (and total row conservation), not the padded per-vrank layout
+    ref = _reference_state(cfg)
+    assert int(sup.driver.state[3].sum()) == int(ref[3].sum())
+    assert elastic.particle_set(*sup.driver.state) == \
+        elastic.particle_set(*ref)
+
+
+def test_restore_latest_onto_explicit_grid(tmp_path):
+    cfg = _cfg(tmp_path)
+    drv = ServiceDriver(cfg)
+    drv.init_state()
+    drv.run(max_steps=8)
+    drv.close()
+
+    res = ServiceDriver(_cfg(tmp_path))
+    assert res.restore_latest(grid_shape=(1, 2, 2)) is True
+    assert res.step == 8
+    assert tuple(res.cfg.grid_shape) == (1, 2, 2)
+    assert res.cfg.n_local == 512
+    ev = res.recorder.last("reshard")
+    assert ev.data["new_grid"] == [1, 2, 2]
+    # live rows conserved through the reshard
+    assert int(res.state[3].sum()) == int(drv.state[3].sum())
+    res.run()  # 8 -> 24 on the smaller mesh
+    res.close()
+    assert elastic.particle_set(*res.state) == \
+        elastic.particle_set(*_reference_state(cfg))
+
+
+def test_elastic_restore_disabled_raises_naming_both_shapes(tmp_path):
+    cfg = _cfg(tmp_path)
+    drv = ServiceDriver(cfg)
+    drv.init_state()
+    drv.run(max_steps=4)
+    drv.close()
+
+    # the same layout restores fine with auto_reshard off
+    same = ServiceDriver(_cfg(tmp_path, auto_reshard=False))
+    assert same.restore_latest() is True
+
+    # a different layout must fail FAST with both shapes in the message
+    strict = ServiceDriver(
+        _cfg(tmp_path, grid_shape=(1, 2, 2), n_local=512,
+             auto_reshard=False)
+    )
+    with pytest.raises(ElasticRestoreError) as ei:
+        strict.restore_latest()
+    msg = str(ei.value)
+    assert "(2, 2, 2)" in msg and "(1, 2, 2)" in msg
+    assert "auto_reshard is disabled" in msg
+
+
+def test_slo_breach_restarts_then_shrinks(tmp_path):
+    # a latency-spike flood breaches the p99 SLO at the step-4 health
+    # check -> restart; the leftover spikes breach again -> second
+    # consecutive breach trips the shrink policy -> restart onto
+    # shrink_shape((2,2,2)) with an elastic re-shard; the spike budget is
+    # then spent, so the third attempt completes clean with no operator
+    # input anywhere
+    cfg = _cfg(
+        tmp_path, steps=32, slo_latency_p99_s=0.25, slo_window=4,
+    )
+    plan = FaultPlan([LatencySpikeFault(2, seconds=1.0, spikes=6)])
+    sup, rec = _supervised(tmp_path, cfg, plan, shrink_after=2)
+    verdict = sup.run()
+
+    assert verdict.ok is True, verdict
+    assert verdict.restarts == 2
+    assert tuple(sup.driver.cfg.grid_shape) == (1, 2, 2)
+    actions = [e.data["action"] for e in rec.events("restart")]
+    assert actions == ["restart", "shrink", "restart"]
+    reasons = [
+        e.data["reason"] for e in rec.events("restart")
+        if e.data["action"] == "restart"
+    ]
+    assert all("SLOBreachError" in r for r in reasons)
+    assert all("slo_latency_p99" in r for r in reasons)
+    (shrink,) = [
+        e for e in rec.events("restart") if e.data["action"] == "shrink"
+    ]
+    assert shrink.data["old_grid"] == [2, 2, 2]
+    assert shrink.data["new_grid"] == [1, 2, 2]
+    assert len(rec.events("reshard")) == 1
+    assert rec.counts().get("fault_injected") == 1
+
+
+# ------------------------------------------------- breaker boundaries
+
+
+class _FailFirstN:
+    """Scripted injector: crash the first ``n`` runs (at step 1), then
+    let every later run succeed — exact failure counts for boundary
+    tests, where CrashFault(None) can only fail forever."""
+
+    kind = "fail_first_n"
+
+    def __init__(self, n):
+        self.left = int(n)
+
+    def before_step(self, driver):
+        if self.left > 0 and driver.step == 1:
+            self.left -= 1
+            raise InjectedCrash("scripted failure")
+
+
+def _ticking_clock(spacing):
+    """Deterministic clock: each restart loop reads the same instant
+    twice (breaker check + window append), instants ``spacing`` apart."""
+
+    def gen():
+        t = 0.0
+        while True:
+            yield t
+            yield t
+            t += spacing
+
+    it = gen()
+    return lambda: next(it)
+
+
+def _boundary_sup(tmp_path, n_failures, policy, clock):
+    cfg = _cfg(tmp_path, steps=4, snapshot_every=0, snapshot_dir=None)
+    rec = StepRecorder()
+    plan = FaultPlan([_FailFirstN(n_failures)])
+    sup = Supervisor(
+        lambda: ServiceDriver(cfg, recorder=rec, faults=plan),
+        policy=policy,
+        recorder=rec,
+        sleep_fn=lambda s: None,
+        clock=clock,
+    )
+    return sup, rec
+
+
+def test_breaker_count_boundary(tmp_path):
+    # all failures at one instant (static clock): exactly max_restarts
+    # failures must NOT trip the breaker (the max_restarts-th restart is
+    # still granted), one more must
+    policy = RestartPolicy(
+        max_restarts=3, backoff_base_s=0.01, backoff_cap_s=0.02
+    )
+    sup, rec = _boundary_sup(tmp_path, 3, policy, lambda: 0.0)
+    verdict = sup.run()
+    assert verdict.ok is True and verdict.gave_up is False
+    assert verdict.restarts == 3
+
+    sup, rec = _boundary_sup(tmp_path, 4, policy, lambda: 0.0)
+    verdict = sup.run()
+    assert verdict.ok is False and verdict.gave_up is True
+    assert verdict.restarts == 3
+    actions = [e.data["action"] for e in rec.events("restart")]
+    assert actions == ["restart"] * 3 + ["give_up"]
+
+
+def test_breaker_window_boundary_is_inclusive(tmp_path):
+    # failures spaced EXACTLY window_s apart: the inclusive window keeps
+    # at most one prior restart in view, so max_restarts=2 never trips
+    # even through 5 straight failures
+    policy = RestartPolicy(
+        max_restarts=2, window_s=10.0, backoff_base_s=0.01,
+        backoff_cap_s=0.02,
+    )
+    sup, rec = _boundary_sup(tmp_path, 5, policy, _ticking_clock(10.0))
+    verdict = sup.run()
+    assert verdict.ok is True and verdict.gave_up is False
+    assert verdict.restarts == 5
+
+    # the same failures clustered INSIDE the window (spacing < window_s)
+    # trip the breaker at the count boundary
+    sup, rec = _boundary_sup(tmp_path, 5, policy, _ticking_clock(5.0))
+    verdict = sup.run()
+    assert verdict.ok is False and verdict.gave_up is True
+    assert verdict.restarts == 2
+    actions = [e.data["action"] for e in rec.events("restart")]
+    assert actions == ["restart"] * 2 + ["give_up"]
+
+
+def test_backoff_jitter_deterministic_under_seed(tmp_path):
+    def backoffs(seed):
+        policy = RestartPolicy(
+            max_restarts=5, backoff_base_s=0.01, backoff_cap_s=1.0,
+            seed=seed,
+        )
+        sup, rec = _boundary_sup(tmp_path, 3, policy, lambda: 0.0)
+        assert sup.run().ok is True
+        return [
+            e.data["backoff_s"] for e in rec.events("restart")
+            if e.data["action"] == "restart"
+        ]
+
+    a = backoffs(7)
+    assert len(a) == 3
+    # the jitter stream is seeded: same seed -> identical journaled
+    # schedule; different seed -> different jitter
+    assert backoffs(7) == a
+    assert backoffs(8) != a
+    # bounded exponential under jitter in [1, 1+jitter): each attempt's
+    # floor (base*2^k) clears the previous attempt's ceiling
+    assert a == sorted(a) and all(x > 0 for x in a)
 
 
 # ------------------------------------------------- plan and health rule
