@@ -1,7 +1,7 @@
 PY ?= python
 
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
-	soak soak-smoke
+	soak soak-smoke rebalance-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -58,6 +58,16 @@ soak-smoke:
 		BENCH_SCALE=0.02 BENCH_SOAK_STEPS=12 BENCH_SOAK_EVERY=4 \
 		BENCH_SOAK_K=2 SOAK_OVERHEAD_MAX=10 \
 		$(PY) -m mpi_grid_redistribute_tpu.bench.config8_soak --soak
+
+# CI-speed closed-loop adaptive-rebalance gate (ISSUE 9): twin config4
+# drift-bias runs, loop on/off — asserts the imbalance_ratio ALERT
+# fired, a rebalance applied, post-rebalance imbalance <= 1.1x, zero
+# dropped rows, and the id-sorted particle set is bit-identical to the
+# no-rebalance twin. The steady-state ms/step is regress-guarded
+# (rebalance_drift_ms, LOWER) against committed captures instead.
+rebalance-smoke:
+	JAX_PLATFORMS=cpu \
+		$(PY) -m mpi_grid_redistribute_tpu.bench.config4_drift --rebalance
 
 # gridlint: AST-based SPMD/JIT invariant checker (G001-G008).
 # Exit 0 = clean or fully baselined; 1 = new findings or stale baseline

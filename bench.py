@@ -268,6 +268,17 @@ def main() -> None:
 
         soak = config8_soak.run()
 
+    # closed-loop adaptive-rebalance capture (bench/config4_drift
+    # .run_rebalance): twin drift-bias runs with the loop on/off —
+    # guards rebalance_drift_ms (LOWER) so the one-shot remap keeps
+    # paying for itself across PRs; CPU-only (numpy backend), so the
+    # capture is deterministic modulo host timing noise
+    rebalance = None
+    if os.environ.get("BENCH_REBALANCE", "1") != "0":
+        from mpi_grid_redistribute_tpu.bench import config4_drift
+
+        rebalance = config4_drift.run_rebalance()
+
     print(
         json.dumps(
             {
@@ -308,6 +319,7 @@ def main() -> None:
                 ),
                 "stress": stress,
                 "soak": soak,
+                "rebalance": rebalance,
                 # environment fingerprint (telemetry.regress): the
                 # classifier flags cross-capture deltas whose machine
                 # changed out from under them

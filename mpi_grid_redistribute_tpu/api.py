@@ -460,7 +460,9 @@ class GridRedistribute:
         routing, the oracle backend and :func:`oracle.assert_ownership`
         all honor the edges; uniform cells remain the default. Build
         load-balancing edges from sample data with
-        :meth:`GridEdges.balanced_for`.
+        :meth:`GridEdges.balanced_for`, or let the adaptive loop install
+        assignment-aware edges (fine cell -> rank LPT maps) at runtime
+        via :meth:`apply_assignment`.
     """
 
     def __init__(
@@ -949,6 +951,31 @@ class GridRedistribute:
         raise RuntimeError(
             f"capacity growth did not converge in {max_attempts} attempts"
         )
+
+    def apply_assignment(
+        self, edges, positions, *fields, count=None
+    ) -> RedistributeResult:
+        """Rebind ownership to ``edges`` (typically assignment-aware —
+        the :class:`~.telemetry.rebalance.RebalancePlanner`'s fresh
+        fine-cell -> rank map) and re-home the state in ONE canonical
+        redistribute — the actuation half of the adaptive-rebalancing
+        loop.
+
+        The new edges stick on the instance: every subsequent
+        :meth:`redistribute` routes by them, and the exchange builders
+        recompile exactly once per distinct edges value (they are an
+        ``lru_cache`` key). The big redistribute itself is just a row
+        permutation — the returned particle SET is bit-identical to the
+        input set (id-audited via ``service.elastic.particle_set`` in the
+        closed-loop tests), and overflow heals by growing like any other
+        call. Pass ``edges=None`` to revert to uniform cells.
+        """
+        if edges is not None and not isinstance(edges, GridEdges):
+            edges = GridEdges(edges)
+        if edges is not None:
+            edges.validate_against(self.domain, self.grid)
+        self.edges = edges
+        return self.redistribute(positions, *fields, count=count)
 
     def halo(
         self,

@@ -99,6 +99,149 @@ def canonical_wire_capture(
     }
 
 
+def run_rebalance(
+    n_local: int = 4096,
+    steps: int = 128,
+    backend: str = "numpy",
+    threshold: float = 1.5,
+) -> dict:
+    """Closed-loop adaptive-rebalance leg: drift bias vs the actuation.
+
+    Twin :class:`~..service.driver.ServiceDriver` runs share one seeded
+    state and one convergent drift bias (the config4 ``--bias`` flight
+    plan, slowed so the cloud never collapses to a point): one run has
+    the closed loop OFF (the imbalance just grows until the hot rank
+    overflows, ``on_overflow='grow'`` widens the padded arrays, steps
+    get slower), the other has it ON (``imbalance_ratio`` ALERT -> plan
+    -> amortization guard -> one-shot ``apply_assignment``). The leg
+    proves the loop end to end:
+
+    * the ALERT fired and a ``rebalance`` event applied;
+    * post-rebalance imbalance <= 1.1x (the LPT plan over fine cells);
+    * the global particle SET is bit-identical with the loop on/off
+      (``elastic.particle_set``: a rebalance only changes ownership);
+    * zero dropped rows either way;
+    * steady-state ms/step with the loop ON at or below the no-rebalance
+      twin (``rebalance_drift_ms`` is regress-guarded LOWER, auto-armed).
+
+    CI-speed by construction (numpy backend, small state): this is what
+    ``make rebalance-smoke`` runs.
+    """
+    from mpi_grid_redistribute_tpu.service import elastic
+    from mpi_grid_redistribute_tpu.service.driver import (
+        DriverConfig,
+        ServiceDriver,
+    )
+
+    def one(rebalance: bool):
+        cfg = DriverConfig(
+            grid_shape=(2, 2, 2),
+            n_local=n_local,
+            fill=0.5,
+            steps=steps,
+            backend=backend,
+            health_every=4,
+            rebalance=rebalance,
+            rebalance_threshold=threshold,
+            rebalance_cells=8,
+            rebalance_cooldown=16,
+            # CI-speed leg: the saving is projected over the service
+            # horizon, not the short smoke, so the guard can fire inside
+            # a 64-step run (the decline path is covered by scripted
+            # gauges in tests/test_rebalance.py)
+            rebalance_horizon=512,
+        )
+        drv = ServiceDriver(cfg)
+        drv.init_state()
+        pos, vel, ids, count = drv.state
+        # convergent flight plan into one shard (config4 --bias), slowed
+        # so rows are only ~60% of the way to the sink at run end: the
+        # bias is sustained (the hot octant's share keeps climbing, the
+        # no-rebalance twin overflows and grows) but the cloud never
+        # collapses to a point (a single occupied fine cell is
+        # unsplittable by any map; velocities are constant passengers,
+        # so a full flight plan would focus every row through the sink
+        # on the same step)
+        sink = np.asarray([0.25, 0.25, 0.25], np.float32)
+        vel = ((sink[None, :] - pos)
+               / np.float32(1.6 * steps)).astype(np.float32)
+        drv.state = (pos, vel, ids, count)
+        dropped = 0
+        drv.run()
+        drv.close()
+        dropped = sum(
+            int(e.data.get("dropped", 0))
+            for e in drv.recorder.events("step_latency")
+        )
+        lat = [
+            float(e.data["seconds"])
+            for e in drv.recorder.events("step_latency")
+        ]
+        # steady state = MEDIAN of the last quarter: by then the
+        # rebalanced twin has long since applied its one-shot remap and
+        # the no-rebalance twin has grown; the median keeps one GC/OS
+        # hiccup from deciding a sub-ms comparison
+        steady = (
+            float(np.median(lat[3 * len(lat) // 4:]))
+            if lat else float("nan")
+        )
+        counts = np.asarray(drv.state[3], np.float64)
+        return {
+            "driver": drv,
+            "steady_s": steady,
+            "dropped": dropped,
+            "final_imbalance": (
+                float(counts.max() / counts.mean())
+                if counts.mean() > 0 else 1.0
+            ),
+            "particle_set": elastic.particle_set(*drv.state),
+            "out_capacity": int(drv._rd.out_capacity or n_local),
+        }
+
+    base = one(False)
+    reb = one(True)
+    drv = reb["driver"]
+    events = [e.data for e in drv.recorder.events("rebalance")]
+    applied = [e for e in events if e.get("applied")]
+    alerts = [
+        e for e in drv.recorder.events("alert")
+        if e.data.get("rule") == "imbalance_ratio"
+    ]
+    res = {
+        "metric": "config4_rebalance_steady_ms",
+        "value": round(reb["steady_s"] * 1e3, 3),
+        "unit": "ms/step",
+        "steady_ms_per_step": round(reb["steady_s"] * 1e3, 3),
+        "baseline_steady_ms_per_step": round(base["steady_s"] * 1e3, 3),
+        "speedup": round(base["steady_s"] / reb["steady_s"], 3)
+        if reb["steady_s"] > 0 else None,
+        "alerts": len(alerts),
+        "rebalances": len(events),
+        "rebalances_applied": len(applied),
+        "post_rebalance_imbalance": (
+            max(float(e["realized_imbalance"]) for e in applied)
+            if applied else None
+        ),
+        "final_imbalance": round(reb["final_imbalance"], 4),
+        "baseline_final_imbalance": round(base["final_imbalance"], 4),
+        "rows_moved": sum(int(e.get("rows_moved", 0)) for e in applied),
+        "dropped": reb["dropped"] + base["dropped"],
+        "out_capacity": reb["out_capacity"],
+        "baseline_out_capacity": base["out_capacity"],
+        "bit_identical": bool(
+            reb["particle_set"] == base["particle_set"]
+        ),
+    }
+    common.log(
+        f"config4 rebalance: {res['steady_ms_per_step']:.3f} ms/step vs "
+        f"{res['baseline_steady_ms_per_step']:.3f} no-rebalance, "
+        f"{len(applied)} applied, post-imbalance "
+        f"{res['post_rebalance_imbalance']}, "
+        f"bit_identical={res['bit_identical']}"
+    )
+    return res
+
+
 def run(
     n_local: int = None,
     migration: float = 0.02,
@@ -215,5 +358,37 @@ def run(
     return res
 
 
+def rebalance_smoke() -> int:
+    """``make rebalance-smoke`` gate: run the closed-loop leg and FAIL
+    (exit 1) unless every acceptance clause holds — ALERT fired, a
+    rebalance applied, post-rebalance imbalance <= 1.1x, zero dropped
+    rows on both twins, and the id-sorted particle set bit-identical to
+    the no-rebalance run. The steady-state ms/step itself is guarded by
+    regress.py (``rebalance_drift_ms``, LOWER) against committed bench
+    captures, not here — a smoke box's absolute timing is noise."""
+    res = run_rebalance()
+    common.emit(res)
+    checks = {
+        "imbalance_ratio ALERT fired": res["alerts"] >= 1,
+        "a rebalance applied": res["rebalances_applied"] >= 1,
+        "post-rebalance imbalance <= 1.1": (
+            res["post_rebalance_imbalance"] is not None
+            and res["post_rebalance_imbalance"] <= 1.1
+        ),
+        "zero dropped rows": res["dropped"] == 0,
+        "particle set bit-identical": res["bit_identical"],
+    }
+    failed = [name for name, ok in checks.items() if not ok]
+    for name in failed:
+        common.log(f"rebalance-smoke FAIL: {name}")
+    if not failed:
+        common.log("rebalance-smoke: all gates green")
+    return 1 if failed else 0
+
+
 if __name__ == "__main__":
+    import sys as _sys
+
+    if "--rebalance" in _sys.argv[1:]:
+        _sys.exit(rebalance_smoke())
     common.emit(run())

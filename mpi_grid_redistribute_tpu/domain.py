@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 
 def _as_float_tuple(x, ndim: int, name: str) -> Tuple[float, ...]:
@@ -211,11 +211,29 @@ class GridEdges:
     keep uniform cells (their per-axis arithmetic is fused into Pallas
     kernels; pair non-uniform ownership with ``DriftConfig.assignment``
     there instead).
+
+    **Assignment-aware edges** (adaptive rebalancing): with
+    ``assignment`` set, the edges define a FINE cell grid —
+    ``len(edges[a]) - 1`` cells per axis, typically finer than the
+    process grid — and ``assignment`` maps each row-major flat fine cell
+    to its owning rank. This is the LPT complement to moving boundaries:
+    ``parallel.migrate.balanced_assignment`` re-bins measured per-cell
+    loads onto ranks without constraining each rank's territory to a
+    box, so a drifting hot spot can be split across ranks at fine-cell
+    granularity. Ownership is then NON-CONTIGUOUS:
+    :meth:`subdomain_of_rank` has no single box to return and raises.
+    Without ``assignment`` the classic shape+1 identity mapping applies
+    unchanged.
     """
 
     edges: Tuple[Tuple[float, ...], ...]
+    assignment: Optional[Tuple[int, ...]] = None
 
-    def __init__(self, edges: Sequence[Sequence[float]]):
+    def __init__(
+        self,
+        edges: Sequence[Sequence[float]],
+        assignment: Optional[Sequence[int]] = None,
+    ):
         object.__setattr__(
             self,
             "edges",
@@ -236,10 +254,62 @@ class GridEdges:
                     f"edges axis {a} must be strictly increasing and "
                     f"NaN-free, got {ax}"
                 )
+        if assignment is not None:
+            assignment = tuple(int(r) for r in assignment)
+            n_cells = math.prod(self.cells_shape)
+            if len(assignment) != n_cells:
+                raise ValueError(
+                    f"assignment has {len(assignment)} entries for "
+                    f"{n_cells} cells (edges define {self.cells_shape})"
+                )
+            if any(r < 0 for r in assignment):
+                raise ValueError("assignment ranks must be >= 0")
+        object.__setattr__(self, "assignment", assignment)
+        # derived (not a dataclass field — eq/hash stay on edges +
+        # assignment): per-axis "is an exact np.linspace reproduction"
+        # flag. Uniformly spaced axes take the floor-multiply binning
+        # fast path in ops.binning instead of the per-edge digitize —
+        # the rebalance planner's fine grids are always linspace-built,
+        # and the compare-sum was the oracle's hot-path cost under
+        # assignment-aware edges. Detection is EXACT equality with the
+        # linspace reconstruction, so hand-built near-uniform edges
+        # conservatively keep digitize semantics.
+        import numpy as _np
+
+        object.__setattr__(
+            self,
+            "uniform_axes",
+            tuple(
+                _np.array_equal(
+                    _np.asarray(ax, dtype=_np.float64),
+                    _np.linspace(ax[0], ax[-1], len(ax)),
+                )
+                for ax in self.edges
+            ),
+        )
 
     @property
     def ndim(self) -> int:
         return len(self.edges)
+
+    @property
+    def cells_shape(self) -> Tuple[int, ...]:
+        """Per-axis cell counts these edges define (``len(edges[a]) - 1``).
+        Equals ``grid.shape`` for identity-mapped edges; finer for
+        assignment-aware edges."""
+        return tuple(len(ax) - 1 for ax in self.edges)
+
+    @property
+    def cell_strides(self) -> Tuple[int, ...]:
+        """Row-major strides over :attr:`cells_shape` (flat fine-cell id =
+        ``sum(cell[a] * cell_strides[a])`` — the index into
+        :attr:`assignment`)."""
+        strides = []
+        acc = 1
+        for s in reversed(self.cells_shape):
+            strides.append(acc)
+            acc *= s
+        return tuple(reversed(strides))
 
     def validate_against(self, domain: Domain, grid: ProcessGrid) -> None:
         grid.validate_against(domain)
@@ -248,23 +318,51 @@ class GridEdges:
                 f"edges ndim {self.ndim} != grid ndim {grid.ndim}"
             )
         for a, ax in enumerate(self.edges):
-            if len(ax) != grid.shape[a] + 1:
+            if self.assignment is None and len(ax) != grid.shape[a] + 1:
                 raise ValueError(
                     f"edges axis {a}: {len(ax)} boundaries for "
-                    f"{grid.shape[a]} cells (need shape+1)"
+                    f"{grid.shape[a]} cells (need shape+1, or pass an "
+                    f"assignment for finer-than-grid cells)"
                 )
             if ax[0] != domain.lo[a] or ax[-1] != domain.hi[a]:
                 raise ValueError(
                     f"edges axis {a} must span [{domain.lo[a]}, "
                     f"{domain.hi[a]}] exactly, got [{ax[0]}, {ax[-1]}]"
                 )
+        if self.assignment is not None and max(self.assignment) >= grid.nranks:
+            raise ValueError(
+                f"assignment references rank {max(self.assignment)} but "
+                f"grid {grid.shape} has only {grid.nranks} ranks"
+            )
 
     def subdomain_of_rank(self, rank: int, grid: ProcessGrid):
-        """(lo, hi) bounds of ``rank``'s owned subvolume under these edges."""
+        """(lo, hi) bounds of ``rank``'s owned subvolume under these edges.
+
+        Only defined for identity-mapped edges: an ``assignment`` makes a
+        rank's territory a union of fine cells, not a box."""
+        if self.assignment is not None:
+            raise ValueError(
+                "subdomain_of_rank is undefined for assignment-aware "
+                "edges: a rank owns a set of fine cells, not one box — "
+                "enumerate cells via rank_cells_of instead"
+            )
         cell = grid.cell_of_rank(rank)
         lo = tuple(self.edges[a][cell[a]] for a in range(self.ndim))
         hi = tuple(self.edges[a][cell[a] + 1] for a in range(self.ndim))
         return lo, hi
+
+    def rank_cells_of(self, rank: int) -> Tuple[int, ...]:
+        """Flat fine-cell ids owned by ``rank`` under :attr:`assignment`
+        (empty tuple when the rank owns no cells — legal under LPT when
+        there are more ranks than loaded cells)."""
+        if self.assignment is None:
+            raise ValueError(
+                "rank_cells_of needs assignment-aware edges; identity "
+                "edges map grid cell == rank (use grid.cell_of_rank)"
+            )
+        return tuple(
+            c for c, r in enumerate(self.assignment) if r == rank
+        )
 
     @staticmethod
     def balanced_for(

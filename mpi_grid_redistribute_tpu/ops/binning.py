@@ -114,15 +114,55 @@ def wrap_periodic(pos, domain: Domain, xp=jnp):
 
 def _digitize_edges(p, axis_edges, xp):
     """Compare-sum digitize of one axis: ``#{k in 1..g-1 : p >= edges[k]}``
-    — ``np.digitize(p, inner_edges)`` semantics, shared verbatim between
-    the row-major and planar paths and between the NumPy oracle and the
-    jax engines (``xp=``), so a semantics change cannot desynchronize
-    them."""
+    — ``np.digitize(p, inner_edges)`` semantics, shared between the
+    row-major and planar paths and between the NumPy oracle and the jax
+    engines (``xp=``), so a semantics change cannot desynchronize them.
+
+    The NumPy twin takes ``searchsorted(inner, p, 'right')`` instead of
+    the g-2 Python-level broadcast compares: both count the inner edges
+    ``<= p`` — pure comparisons against the same float values, no
+    arithmetic on ``p`` — so the two forms are equal on every input
+    including exact-tie positions, and the C loop is what keeps the
+    oracle's assignment-aware routing off the hot-path flamegraph
+    (the native C++ ``bin_positions`` never sees edges)."""
+    if xp is np:
+        # ``p`` is a host array on this branch (xp is np) and
+        # ``axis_edges`` is a static Python tuple — no traced value
+        inner = np.asarray(  # gridlint: disable=G002
+            axis_edges[1:-1], dtype=p.dtype
+        )
+        return np.searchsorted(inner, p, side="right").astype(np.int32)
     c = xp.zeros(p.shape, dtype=xp.int32)
     for k in range(1, len(axis_edges) - 1):
         b = xp.asarray(axis_edges[k], dtype=p.dtype)
         c = c + (p >= b).astype(xp.int32)
     return c
+
+
+def _cell_uniform_axis(p, axis_edges, xp):
+    """Floor-multiply binning of one UNIFORMLY-SPACED edges axis:
+    ``clip(floor((p - lo) * g / (hi - lo)), 0, g - 1)`` — the same
+    arithmetic as the default uniform-grid path, shared between the
+    backends (``xp=``) so they stay bit-identical by construction. Only
+    engaged for axes :class:`~..domain.GridEdges` detected as exact
+    ``np.linspace`` reproductions (``uniform_axes``): there the edge
+    grid IS a uniform grid, and the per-edge digitize was the oracle's
+    hot-path cost under assignment-aware fine grids."""
+    g = len(axis_edges) - 1
+    lo = xp.asarray(axis_edges[0], dtype=p.dtype)
+    inv = xp.asarray(
+        g / (axis_edges[-1] - axis_edges[0]), dtype=p.dtype
+    )
+    c = xp.floor((p - lo) * inv).astype(xp.int32)
+    return xp.clip(c, 0, g - 1)
+
+
+def _cell_edges_axis(p, edges, a, xp):
+    """One axis of the ``edges`` digitize: floor-multiply fast path for
+    uniformly spaced axes, compare-sum digitize otherwise."""
+    if getattr(edges, "uniform_axes", (False,) * edges.ndim)[a]:
+        return _cell_uniform_axis(p, edges.edges[a], xp)
+    return _digitize_edges(p, edges.edges[a], xp)
 
 
 def cell_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
@@ -143,11 +183,15 @@ def cell_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
     engine (``xp=``), so backend bit-compatibility holds by
     construction — no searchsorted lowering is involved (TPU
     ``method="sort"`` hides a full-length scatter; see
-    :func:`bounds_dense`).
+    :func:`bounds_dense`). Axes whose edges are an exact uniform
+    lattice (``GridEdges.uniform_axes`` — e.g. the rebalance planner's
+    linspace-built fine grids) take the same floor-multiply arithmetic
+    as the default path instead of the per-edge digitize, on both
+    backends.
     """
     if edges is not None:
         cols = [
-            _digitize_edges(pos[..., a], edges.edges[a], xp)
+            _cell_edges_axis(pos[..., a], edges, a, xp)
             for a in range(grid.ndim)
         ]
         return xp.stack(cols, axis=-1)
@@ -166,13 +210,30 @@ def rank_of_cell(cell, grid: ProcessGrid, xp=jnp):
     return xp.sum(cell * strides, axis=-1).astype(xp.int32)
 
 
+def _assigned_rank(flat_cell, edges, xp):
+    """Fine-cell -> rank table gather for assignment-aware
+    :class:`~..domain.GridEdges` (adaptive rebalancing). The assignment
+    is a static tuple, so under jit the table is a compile-time constant
+    and the gather is one ``take`` — the same pattern the migrate
+    engine's ``cells``+``assignment`` routing uses."""
+    table = xp.asarray(edges.assignment, dtype=xp.int32)
+    return xp.take(table, flat_cell).astype(xp.int32)
+
+
 def rank_of_position(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
                      edges=None):
-    """Fused wrap -> digitize -> cell->rank map: destination rank per particle."""
+    """Fused wrap -> digitize -> cell->rank map: destination rank per particle.
+
+    With assignment-aware ``edges`` the digitize runs over the FINE cell
+    grid the edges define and the rank is read from the assignment table;
+    otherwise cells map to ranks by row-major strides (identity)."""
     pos = wrap_periodic(pos, domain, xp=xp)
-    return rank_of_cell(
-        cell_of_position(pos, domain, grid, xp=xp, edges=edges), grid, xp=xp
-    )
+    cell = cell_of_position(pos, domain, grid, xp=xp, edges=edges)
+    if edges is not None and edges.assignment is not None:
+        strides = xp.asarray(edges.cell_strides, dtype=xp.int32)
+        flat = xp.sum(cell * strides, axis=-1).astype(xp.int32)
+        return _assigned_rank(flat, edges, xp)
+    return rank_of_cell(cell, grid, xp=xp)
 
 
 def wrap_periodic_planar(pos, domain: Domain, xp=jnp):
@@ -207,7 +268,7 @@ def cell_of_position_planar(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
     for d in range(pos.shape[-2]):
         p = pos[..., d, :]
         if edges is not None:
-            out.append(_digitize_edges(p, edges.edges[d], xp))
+            out.append(_cell_edges_axis(p, edges, d, xp))
             continue
         inv_w = xp.asarray(
             grid.shape[d] / domain.extent[d], dtype=pos.dtype
@@ -223,10 +284,14 @@ def rank_of_position_planar(pos, domain: Domain, grid: ProcessGrid, xp=jnp,
     """Planar twin of :func:`rank_of_position` for ``[..., D, n]`` layouts."""
     pos = wrap_periodic_planar(pos, domain, xp=xp)
     cell = cell_of_position_planar(pos, domain, grid, xp=xp, edges=edges)
+    assigned = edges is not None and edges.assignment is not None
+    strides = edges.cell_strides if assigned else grid.strides
     rank = None
     for d in range(cell.shape[-2]):
-        t = cell[..., d, :] * xp.int32(grid.strides[d])
+        t = cell[..., d, :] * xp.int32(strides[d])
         rank = t if rank is None else rank + t
+    if assigned:
+        return _assigned_rank(rank.astype(xp.int32), edges, xp)
     return rank.astype(xp.int32)
 
 

@@ -141,6 +141,23 @@ def redistribute_oracle_padded(
             # edges pin the (bit-identical) NumPy branch
             dest = native.bin_positions(np.asarray(pos[sl]), domain, grid)
             dcounts, order = native.count_sort(dest, R)
+        elif (
+            native_ok
+            and edges.assignment is not None
+            and all(edges.uniform_axes)
+        ):
+            # assignment-aware UNIFORM fine edges (the rebalance
+            # planner's linspace-built grids): the fine lattice IS a
+            # uniform grid, so the C++ digitize against a fine
+            # ProcessGrid yields the flat fine cell (row-major strides
+            # == GridEdges.cell_strides) and the rank is one table
+            # gather — bit-identical to ops.binning's shared
+            # floor-multiply fast path, which is the same arithmetic
+            flat = native.bin_positions(
+                np.asarray(pos[sl]), domain, ProcessGrid(edges.cells_shape)
+            )
+            dest = np.asarray(edges.assignment, dtype=np.int32)[flat]
+            dcounts, order = native.count_sort(dest, R)
         else:
             dest = binning.rank_of_position(
                 np.asarray(pos[sl]), domain, grid, xp=np, edges=edges

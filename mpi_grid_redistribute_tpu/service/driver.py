@@ -91,6 +91,18 @@ class DriverConfig:
     slo_latency_p99_s: float = 0.0   # p99 step-latency budget; 0 = off
     slo_dropped_p99: int = -1        # p99 dropped-rows budget; -1 = off
     slo_window: int = 16             # step_latency events per SLO window
+    # adaptive rebalancing (ROADMAP item 2): the imbalance_ratio rule is
+    # raised to ALERT severity at `rebalance_threshold`, and each firing
+    # at a health boundary runs plan (telemetry.rebalance.RebalancePlanner,
+    # fine-cell occupancy -> LPT) -> amortization guard -> one-shot
+    # GridRedistribute.apply_assignment, journaling a `rebalance` event
+    # whether it applied or declined (telemetry/SCHEMA.md)
+    rebalance: bool = False
+    rebalance_threshold: float = 2.0  # imbalance_ratio ALERT threshold
+    rebalance_cells: int = 2          # fine cells per grid cell per axis
+    rebalance_horizon: int = 256      # guard amortization horizon (steps)
+    rebalance_cooldown: int = 64      # min steps between applied remaps
+    rebalance_min_improvement: float = 0.05
 
 
 class ServiceDriver:
@@ -133,7 +145,14 @@ class ServiceDriver:
         self._writer: Optional[threading.Thread] = None
         self._writer_error: Optional[str] = None
         self._last_snapshot_path: Optional[str] = None
+        # adaptive rebalancing: the current assignment-aware edges (must
+        # survive engine rebuilds — a degrade that dropped them would
+        # silently undo the rebalance), plus lazily-built planner/guard
+        self._edges = None
+        self._planner = None
+        self._guard = None
         self._install_slo_rules()
+        self._install_rebalance_rule()
 
     def _install_slo_rules(self) -> None:
         # the monitor is SHARED across supervisor restarts, so install
@@ -155,6 +174,31 @@ class ServiceDriver:
                     cfg.slo_dropped_p99, window=cfg.slo_window
                 )
             )
+
+    def _install_rebalance_rule(self) -> None:
+        # replace the stock WARN-severity imbalance_ratio rule with an
+        # ALERT copy at the actuation threshold: for the closed loop the
+        # finding is a trigger, not an advisory. Same shared-monitor
+        # discipline as the SLO rules — a restarted driver must not
+        # stack a second copy.
+        from mpi_grid_redistribute_tpu.telemetry import health as health_lib
+
+        cfg = self.cfg
+        if not cfg.rebalance:
+            return
+        if any(
+            r.name == "imbalance_ratio" and r.severity == health_lib.ALERT
+            for r in self.monitor.rules
+        ):
+            return
+        self.monitor.rules = [
+            r for r in self.monitor.rules if r.name != "imbalance_ratio"
+        ]
+        self.monitor.rules.append(
+            health_lib.imbalance_ratio(
+                cfg.rebalance_threshold, severity=health_lib.ALERT
+            )
+        )
 
     # ---------------------------------------------------------- build
 
@@ -179,6 +223,9 @@ class ServiceDriver:
             capacity=cfg.n_local,
             on_overflow="grow",
             engine=self.engine,
+            # re-install the live assignment-aware edges across rebuilds
+            # (degrade drops _rd; the rebalance must not be undone by it)
+            edges=self._edges,
         )
         if cfg.backend == "numpy":
             self._rd = GridRedistribute(
@@ -452,14 +499,35 @@ class ServiceDriver:
             np.asarray(res.count, np.int32),
         )
 
+    def _refresh_flow(self) -> None:
+        # fold the latest redistribute stats into the flow gauge and
+        # journal a flow_snapshot, so the imbalance_ratio rule sees the
+        # CURRENT decomposition (gated on cfg.rebalance in the caller:
+        # non-rebalancing services keep their journal shape unchanged)
+        if self._rd is not None and self._rd._last_stats is not None:
+            self._rd.flow(update=True)
+
     def _health_check(self) -> dict:
         from mpi_grid_redistribute_tpu.service.faults import SLOBreachError
 
+        if self.cfg.rebalance:
+            self._refresh_flow()
         verdict = self.monitor.evaluate()
         if not self.degraded and self.engine != "planar":
             for f in verdict["findings"]:
                 if f["rule"] == "fast_path_fallback":
                     self._degrade(f["reason"])
+                    break
+        if self.cfg.rebalance:
+            # actuate BEFORE the slo_ raise loop: a rebalance that fixes
+            # the hot rank this boundary must not be pre-empted by a
+            # restart the imbalance itself provoked
+            for f in verdict["findings"]:
+                if (
+                    f["rule"] == "imbalance_ratio"
+                    and f["severity"] == "ALERT"
+                ):
+                    self._maybe_rebalance(f)
                     break
         for f in verdict["findings"]:
             # an SLO breach is a FAILURE, not an advisory: raise out of
@@ -467,6 +535,103 @@ class ServiceDriver:
             if f["rule"].startswith("slo_"):
                 raise SLOBreachError(f"{f['rule']}: {f['reason']}")
         return verdict
+
+    def _maybe_rebalance(self, finding: dict) -> None:
+        """ALERT -> plan -> guard -> (maybe) one-shot apply_assignment.
+
+        Journals a ``rebalance`` event on EVERY path — applied or
+        declined — so the closed loop is auditable from the journal
+        alone (telemetry/SCHEMA.md)."""
+        from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+        from mpi_grid_redistribute_tpu.telemetry import flow as flow_lib
+        from mpi_grid_redistribute_tpu.telemetry import rebalance as reb_lib
+
+        cfg = self.cfg
+        if self._planner is None:
+            self._planner = reb_lib.RebalancePlanner(
+                Domain(0.0, 1.0, periodic=True),
+                ProcessGrid(cfg.grid_shape),
+                cells_per_rank_axis=cfg.rebalance_cells,
+            )
+        if self._guard is None:
+            self._guard = reb_lib.AmortizationGuard(
+                horizon_steps=cfg.rebalance_horizon,
+                cooldown_steps=cfg.rebalance_cooldown,
+                min_improvement=cfg.rebalance_min_improvement,
+            )
+        pos, vel, ids, count = self.state
+        plan = self._planner.plan(pos, count=count)
+        if plan is None:
+            self.recorder.record(
+                "rebalance",
+                step=self.step,
+                applied=False,
+                reason="no live rows to balance",
+                trigger=finding["reason"],
+            )
+            return
+        step_s = float(self._wall_ema or 0.0)
+        d = self._guard.consider(
+            step=self.step,
+            step_seconds=step_s,
+            old_imbalance=plan.old_imbalance,
+            projected_imbalance=plan.projected_imbalance,
+        )
+        if not d.apply:
+            self.recorder.record(
+                "rebalance",
+                step=self.step,
+                applied=False,
+                reason=d.reason,
+                trigger=finding["reason"],
+                old_imbalance=plan.old_imbalance,
+                projected_imbalance=plan.projected_imbalance,
+                projected_saving_s=d.projected_saving_s,
+                cost_s=d.cost_s,
+            )
+            return
+        t0 = time.perf_counter()
+        res = self._rd.apply_assignment(plan.edges, pos, vel, ids,
+                                        count=count)
+        self.state = (
+            np.asarray(res.positions),
+            np.asarray(res.fields[0]),
+            np.asarray(res.fields[1], np.int32),
+            np.asarray(res.count, np.int32),
+        )
+        cost = time.perf_counter() - t0
+        self._edges = plan.edges  # survives _rd rebuilds (_ensure_built)
+        m = flow_lib.flow_matrix_of(res.stats)[-1]
+        rows_moved = int(m.sum() - np.trace(m))
+        new_counts = np.asarray(self.state[3], np.float64)
+        realized = (
+            float(new_counts.max() / new_counts.mean())
+            if new_counts.mean() > 0 else 1.0
+        )
+        realized_saving_s = (
+            step_s * (1.0 - realized / plan.old_imbalance)
+            if plan.old_imbalance > 0 else 0.0
+        )
+        self._guard.note_applied(self.step, cost)
+        self.recorder.record(
+            "rebalance",
+            step=self.step,
+            applied=True,
+            reason=d.reason,
+            trigger=finding["reason"],
+            old_imbalance=plan.old_imbalance,
+            projected_imbalance=plan.projected_imbalance,
+            realized_imbalance=realized,
+            rows_moved=rows_moved,
+            projected_saving_s=d.projected_saving_s,
+            realized_saving_s=realized_saving_s,
+            cost_s=cost,
+            n_cells=plan.n_cells,
+            occupied_cells=plan.occupied_cells,
+        )
+        # refresh the gauge from the post-apply stats: the stale
+        # pre-rebalance snapshot must not re-fire the ALERT next boundary
+        self._refresh_flow()
 
     def _degrade(self, reason: str) -> None:
         self.recorder.record(
@@ -629,6 +794,27 @@ def main(argv=None) -> int:
         help="disable elastic restore (mesh-mismatched snapshots error)",
     )
     p.add_argument(
+        "--rebalance", action="store_true",
+        help="close the loop: imbalance_ratio ALERT -> plan -> "
+             "amortization guard -> one-shot apply_assignment",
+    )
+    p.add_argument(
+        "--rebalance-threshold", type=float, default=2.0,
+        help="imbalance ratio (max/mean) that trips the ALERT",
+    )
+    p.add_argument(
+        "--rebalance-cells", type=int, default=2,
+        help="fine planning cells per grid cell per axis",
+    )
+    p.add_argument(
+        "--rebalance-horizon", type=int, default=256,
+        help="steps the projected saving may amortize the apply cost over",
+    )
+    p.add_argument(
+        "--rebalance-cooldown", type=int, default=64,
+        help="minimum steps between applied remaps",
+    )
+    p.add_argument(
         "--shrink-after", type=int, default=0, metavar="N",
         help="supervise mode: shrink the mesh after N consecutive "
              "SLO-breach restarts (0 = never)",
@@ -667,6 +853,11 @@ def main(argv=None) -> int:
         step_sleep=args.step_sleep,
         auto_reshard=not args.no_reshard,
         slo_latency_p99_s=args.slo_p99,
+        rebalance=args.rebalance,
+        rebalance_threshold=args.rebalance_threshold,
+        rebalance_cells=args.rebalance_cells,
+        rebalance_horizon=args.rebalance_horizon,
+        rebalance_cooldown=args.rebalance_cooldown,
     )
     faults = FaultPlan()
     if args.inject_crash is not None:

@@ -117,7 +117,8 @@ class FlowAccumulator:
         self.cumulative: Optional[np.ndarray] = None  # [R, R] int64
         self.ema: Optional[np.ndarray] = None  # [R, R] float64
         self.steps = 0
-        self.imbalance = 0.0  # latest max/mean population (0 if unknown)
+        self.imbalance = 0.0  # latest max/mean population (0 = never fed)
+        self.population: Optional[np.ndarray] = None  # latest [R] int64
 
     def _init(self, R: int) -> None:
         if self.n_ranks is None:
@@ -168,9 +169,23 @@ class FlowAccumulator:
         self.steps += m.shape[0]
         if population is not None:
             pop = np.asarray(population)
-            per_rank = pop.reshape(-1, pop.shape[-1])[-1].astype(np.float64)
-            mean = per_rank.mean()
-            self.imbalance = float(per_rank.max() / mean) if mean > 0 else 0.0
+            per_rank = pop.reshape(-1, pop.shape[-1])[-1].astype(np.int64)
+            total = int(per_rank.sum())
+            if int(per_rank.min(initial=0)) < 0:
+                raise ValueError(
+                    f"population must be non-negative, got {per_rank}"
+                )
+            self.population = per_rank
+            # total == 0 means EVERY rank is empty (counts are
+            # non-negative): an empty system is perfectly balanced, so
+            # the gauge reads 1.0 — the old 0.0 sentinel conflated
+            # "all-empty" with "never fed", and a some-ranks-empty
+            # population (total > 0) must still read max/mean, where the
+            # empty ranks rightly push the ratio UP, not reset it
+            self.imbalance = (
+                float(int(per_rank.max()) * per_rank.size / total)
+                if total > 0 else 1.0
+            )
 
     def top_pairs(
         self, k: int = 5, ema: bool = False
@@ -182,7 +197,8 @@ class FlowAccumulator:
         return top_pairs(np.asarray(src).astype(np.int64), k=k)
 
     def snapshot(self, k: int = 5) -> Dict[str, object]:
-        """JSON-serializable gauge snapshot (compact: no full matrix)."""
+        """JSON-serializable gauge snapshot (compact: no full matrix —
+        ``population`` is [R] scalars, bounded by the rank count)."""
         moved = 0
         if self.cumulative is not None:
             c = self.cumulative
@@ -192,6 +208,10 @@ class FlowAccumulator:
             "n_ranks": self.n_ranks,
             "moved_rows_total": moved,
             "imbalance": float(self.imbalance),
+            "population": (
+                None if self.population is None
+                else self.population.tolist()
+            ),
             "top_pairs": [list(p) for p in self.top_pairs(k=k)],
         }
 

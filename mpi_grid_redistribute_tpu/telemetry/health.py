@@ -136,9 +136,16 @@ def capacity_grow_frequency(max_grows: int = 3) -> HealthRule:
     return HealthRule("capacity_grow_frequency", WARN, fn)
 
 
-def imbalance_ratio(threshold: float = 2.0) -> HealthRule:
-    """WARN when the latest ``flow_snapshot`` population imbalance
-    (max/mean) exceeds ``threshold``."""
+def imbalance_ratio(
+    threshold: float = 2.0, severity: str = WARN
+) -> HealthRule:
+    """Fire when the latest ``flow_snapshot`` population imbalance
+    (max/mean) exceeds ``threshold``. WARN by default (advisory for an
+    operator); the service driver's adaptive-rebalance loop installs an
+    ALERT-severity copy at its actuation threshold, since for it the
+    finding is a trigger, not a notice."""
+    if severity not in (WARN, ALERT):
+        raise ValueError(f"severity must be WARN or ALERT, got {severity!r}")
 
     def fn(rec: StepRecorder) -> Optional[str]:
         e = rec.last("flow_snapshot")
@@ -152,7 +159,7 @@ def imbalance_ratio(threshold: float = 2.0) -> HealthRule:
             )
         return None
 
-    return HealthRule("imbalance_ratio", WARN, fn)
+    return HealthRule("imbalance_ratio", severity, fn)
 
 
 def step_time_spike(factor: float = 3.0, min_samples: int = 4) -> HealthRule:

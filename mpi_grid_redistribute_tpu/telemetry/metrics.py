@@ -444,7 +444,8 @@ def from_journal(
       exchange wire bytes per engine over the journaled
       ``redistribute`` window;
     * ``alerts_total{rule,severity}`` — health findings journaled;
-    * ``flow_moved_rows`` / ``flow_imbalance`` — latest flow snapshot;
+    * ``flow_moved_rows`` / ``flow_imbalance`` /
+      ``rank_population{vrank}`` — latest flow snapshot;
     * ``step_latency_seconds`` / ``dropped_rows`` — pow2 histograms of
       the service driver's ``step_latency`` events (the SLO surface);
     * ``snapshot_corrupt_total`` — corrupt snapshots skipped at restore.
@@ -541,6 +542,11 @@ def from_journal(
         "Max/mean population imbalance (latest flow_snapshot; 1.0 ="
         " balanced)",
     )
+    flow_pop = reg.gauge(
+        f"{p}_rank_population",
+        "Live rows per vrank (latest flow_snapshot population leaf)",
+        ("vrank",),
+    )
 
     saw_migrate = saw_flow = False
     for kind, data in events:
@@ -588,12 +594,19 @@ def from_journal(
                 flow_moved.labels().set(int(data["moved_rows_total"]))
             if "imbalance" in data:
                 flow_imb.labels().set(float(data["imbalance"]))
+            if data.get("population") is not None:
+                # latest snapshot wins outright: drop stale vrank
+                # children first so a shrunk rank count can't leave
+                # ghost gauges behind
+                flow_pop._children.clear()
+                for vr, rows_live in enumerate(data["population"]):
+                    flow_pop.labels(vrank=vr).set(int(rows_live))
     # gauges with no samples yet would render a misleading 0 — only
     # materialize the step-scoped gauges once their kind has appeared
     if not saw_migrate:
         for fam in (pop_g, back_g):
             fam._children.clear()
     if not saw_flow:
-        for fam in (flow_moved, flow_imb):
+        for fam in (flow_moved, flow_imb, flow_pop):
             fam._children.clear()
     return reg

@@ -1,0 +1,295 @@
+"""Closed-loop adaptive rebalancing: plan + amortization guard.
+
+The observability plane (flow gauges, health rules) can *see* a stale
+decomposition — under a drifting hot spot the ``imbalance_ratio`` rule
+fires step after step while one rank drowns — but until this module it
+could only page an operator. This is the planning half of the actuation
+loop (ROADMAP item 2):
+
+* :class:`RebalancePlanner` measures the live per-cell occupancy
+  histogram over a FINE uniform cell grid (``cells_per_rank_axis`` fine
+  cells per grid cell per axis, binned with the exact
+  ``ops.binning`` digitize the engines route by, so the plan and the
+  actuation cannot disagree), feeds it to the existing LPT machinery
+  (``parallel.migrate.balanced_assignment``), and emits assignment-aware
+  :class:`~..domain.GridEdges` — the fresh cell -> vrank map.
+* :class:`AmortizationGuard` decides whether applying the plan pays:
+  the one-shot "big redistribute" costs real time (recompile + a
+  near-total row permutation), so it only fires when the projected
+  per-step saving clears the measured apply cost within a configurable
+  horizon, with a cooldown so back-to-back remaps can never thrash.
+
+The actuation itself is ``GridRedistribute.apply_assignment`` (one
+canonical redistribute under the new edges); the wiring — ALERT ->
+plan -> guard -> apply, with a journaled ``rebalance`` event either way
+(telemetry/SCHEMA.md) — lives in ``service.driver``.
+
+Projected-saving model (deliberately first-order): the drift loop's step
+time is dominated by the hottest rank — padded shapes, capacity growth
+and the exchange all key off the max-loaded shard — so per-step time
+scales ~ with the imbalance ratio (max/mean), and a remap from
+``old_imb`` to ``proj_imb`` projects a per-step saving of
+``step_seconds * (1 - proj_imb / old_imb)``. Crude, but it is compared
+against a MEASURED cost (EMA of realized apply times, seeded by a
+configurable multiple of the step time before the first apply), and the
+journal records projected vs realized so the model's honesty is
+auditable.
+
+Host-side only: planning is NumPy over host state the driver already
+holds; nothing here syncs the device.
+"""
+# gridlint: service-path
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from mpi_grid_redistribute_tpu.domain import Domain, GridEdges, ProcessGrid
+
+
+class RebalancePlan(NamedTuple):
+    """One planner output: the fresh map plus the numbers the guard and
+    the ``rebalance`` journal event need."""
+
+    edges: GridEdges            # assignment-aware fine-cell -> rank map
+    old_imbalance: float        # max/mean of the measured population
+    projected_imbalance: float  # max/mean of the LPT bin loads
+    n_cells: int                # fine cells in the plan
+    occupied_cells: int         # fine cells with nonzero load
+
+
+class RebalancePlanner:
+    """Measure occupancy, run LPT, emit assignment-aware edges.
+
+    ``cells_per_rank_axis`` sets the planning granularity: each grid
+    cell is split into that many fine cells per axis, so an 8-rank
+    ``(2, 2, 2)`` grid at factor 2 plans over 64 fine cells (8 per
+    rank) — enough freedom for LPT to split a hot spot across ranks
+    while keeping the assignment table a small jit-time constant.
+    """
+
+    def __init__(
+        self,
+        domain: Domain,
+        grid: ProcessGrid,
+        cells_per_rank_axis: int = 2,
+    ):
+        if int(cells_per_rank_axis) < 1:
+            raise ValueError(
+                f"cells_per_rank_axis must be >= 1, got {cells_per_rank_axis}"
+            )
+        grid.validate_against(domain)
+        self.domain = domain
+        self.grid = grid
+        self.cells_shape = tuple(
+            s * int(cells_per_rank_axis) for s in grid.shape
+        )
+        # uniform fine edges, endpoints exact (np.linspace pins both)
+        self.fine_edges = tuple(
+            tuple(
+                float(v)
+                for v in np.linspace(
+                    domain.lo[a], domain.hi[a], self.cells_shape[a] + 1
+                )
+            )
+            for a in range(grid.ndim)
+        )
+
+    def _live_rows(self, positions, count) -> np.ndarray:
+        pos = np.asarray(positions)
+        R = self.grid.nranks
+        if pos.ndim != 2 or pos.shape[0] % R:
+            raise ValueError(
+                f"positions must be [R*n_local, ndim] over {R} ranks, "
+                f"got {pos.shape}"
+            )
+        if count is None:
+            return pos
+        n_local = pos.shape[0] // R
+        c = np.asarray(count, dtype=np.int64)
+        mask = np.arange(n_local)[None, :] < c[:, None]
+        return pos.reshape(R, n_local, -1)[mask]
+
+    def occupancy(self, positions, count=None) -> np.ndarray:
+        """Per-fine-cell live-row histogram ([n_cells] int64, row-major)
+        from the padded global layout — the SAME wrap + digitize the
+        engines route by (``ops.binning`` with ``xp=np``), so a cell's
+        measured load is exactly the rows the actuation will land there.
+        """
+        from mpi_grid_redistribute_tpu.ops import binning
+
+        live = self._live_rows(positions, count)
+        probe = GridEdges(self.fine_edges)
+        wrapped = binning.wrap_periodic(
+            live.astype(np.float32, copy=False), self.domain, xp=np
+        )
+        cell = binning.cell_of_position(
+            wrapped, self.domain, self.grid, xp=np, edges=probe
+        )
+        strides = probe.cell_strides
+        flat = (cell.astype(np.int64) * np.asarray(strides)).sum(axis=-1)
+        return np.bincount(
+            flat, minlength=int(np.prod(self.cells_shape))
+        ).astype(np.int64)
+
+    def plan(self, positions, count=None) -> Optional[RebalancePlan]:
+        """One fresh cell -> rank map from the current state, or ``None``
+        when there is nothing to balance (no live rows)."""
+        from mpi_grid_redistribute_tpu.parallel import migrate
+
+        loads = self.occupancy(positions, count)
+        total = int(loads.sum())
+        if total == 0:
+            return None
+        R = self.grid.nranks
+        assignment = migrate.balanced_assignment(loads, R)
+        bins = np.bincount(
+            np.asarray(assignment), weights=loads.astype(np.float64),
+            minlength=R,
+        )
+        projected = float(bins.max() / bins.mean())
+        if count is None:
+            old = 1.0
+        else:
+            c = np.asarray(count, dtype=np.float64)
+            old = float(c.max() / c.mean()) if c.mean() > 0 else 1.0
+        return RebalancePlan(
+            edges=GridEdges(self.fine_edges, assignment),
+            old_imbalance=old,
+            projected_imbalance=projected,
+            n_cells=int(loads.size),
+            occupied_cells=int((loads > 0).sum()),
+        )
+
+
+class GuardDecision(NamedTuple):
+    """One :meth:`AmortizationGuard.consider` verdict — everything the
+    ``rebalance`` journal event needs to explain itself."""
+
+    apply: bool
+    reason: str                  # human decision trail (skip reason or "go")
+    projected_saving_s: float    # projected per-step saving (seconds)
+    cost_s: float                # apply cost the decision compared against
+
+
+class AmortizationGuard:
+    """Fire the big redistribute only when it amortizes.
+
+    The decision inputs are gauges the driver already has (step-time
+    EMA, the planner's old/projected imbalance); the cost side starts as
+    ``initial_cost_factor`` x the step time (a remap is a near-total
+    permutation plus a recompile, reliably several steps' worth) and
+    converges to the EMA of MEASURED apply costs after the first apply.
+    ``cooldown_steps`` enforces hysteresis: however loud the gauges, two
+    remaps can never run closer than the cooldown, so a plan/actuate
+    feedback oscillation cannot thrash.
+    """
+
+    def __init__(
+        self,
+        horizon_steps: int = 256,
+        cooldown_steps: int = 64,
+        min_improvement: float = 0.05,
+        initial_cost_factor: float = 8.0,
+        cost_alpha: float = 0.5,
+    ):
+        if int(horizon_steps) < 1:
+            raise ValueError(
+                f"horizon_steps must be >= 1, got {horizon_steps}"
+            )
+        if int(cooldown_steps) < 0:
+            raise ValueError(
+                f"cooldown_steps must be >= 0, got {cooldown_steps}"
+            )
+        if not 0.0 <= float(min_improvement) < 1.0:
+            raise ValueError(
+                f"min_improvement must be in [0, 1), got {min_improvement}"
+            )
+        if not 0.0 < float(cost_alpha) <= 1.0:
+            raise ValueError(
+                f"cost_alpha must be in (0, 1], got {cost_alpha}"
+            )
+        self.horizon_steps = int(horizon_steps)
+        self.cooldown_steps = int(cooldown_steps)
+        self.min_improvement = float(min_improvement)
+        self.initial_cost_factor = float(initial_cost_factor)
+        self.cost_alpha = float(cost_alpha)
+        self.cost_ema_s: Optional[float] = None  # measured apply cost
+        self.last_applied_step: Optional[int] = None
+        self.applies = 0
+
+    def consider(
+        self,
+        *,
+        step: int,
+        step_seconds: float,
+        old_imbalance: float,
+        projected_imbalance: float,
+    ) -> GuardDecision:
+        """Should the plan be applied now? Pure decision — no state
+        changes (call :meth:`note_applied` after a realized apply)."""
+        cost = (
+            self.cost_ema_s
+            if self.cost_ema_s is not None
+            else self.initial_cost_factor * max(0.0, float(step_seconds))
+        )
+        if (
+            self.last_applied_step is not None
+            and step - self.last_applied_step < self.cooldown_steps
+        ):
+            remaining = self.cooldown_steps - (step - self.last_applied_step)
+            return GuardDecision(
+                False,
+                f"cooldown: last rebalance at step "
+                f"{self.last_applied_step}, {remaining} steps remaining",
+                0.0,
+                cost,
+            )
+        if old_imbalance <= 0.0:
+            return GuardDecision(
+                False, "no measured imbalance to improve on", 0.0, cost
+            )
+        improvement = 1.0 - projected_imbalance / old_imbalance
+        saving = max(0.0, float(step_seconds)) * improvement
+        if improvement < self.min_improvement:
+            return GuardDecision(
+                False,
+                f"projected improvement {improvement:.1%} below the "
+                f"{self.min_improvement:.1%} floor "
+                f"({old_imbalance:.2f}x -> {projected_imbalance:.2f}x)",
+                max(0.0, saving),
+                cost,
+            )
+        horizon_saving = saving * self.horizon_steps
+        if horizon_saving <= cost:
+            return GuardDecision(
+                False,
+                f"projected saving {saving * 1e3:.3f} ms/step x "
+                f"{self.horizon_steps} steps = {horizon_saving * 1e3:.1f} "
+                f"ms does not clear the {cost * 1e3:.1f} ms apply cost",
+                saving,
+                cost,
+            )
+        return GuardDecision(
+            True,
+            f"projected saving {saving * 1e3:.3f} ms/step clears the "
+            f"{cost * 1e3:.1f} ms apply cost within {self.horizon_steps} "
+            f"steps ({old_imbalance:.2f}x -> {projected_imbalance:.2f}x)",
+            saving,
+            cost,
+        )
+
+    def note_applied(self, step: int, cost_seconds: float) -> None:
+        """Fold one realized apply: arms the cooldown and replaces the
+        seeded cost estimate with a measured EMA."""
+        self.last_applied_step = int(step)
+        self.applies += 1
+        c = max(0.0, float(cost_seconds))
+        self.cost_ema_s = (
+            c
+            if self.cost_ema_s is None
+            else self.cost_alpha * c
+            + (1.0 - self.cost_alpha) * self.cost_ema_s
+        )

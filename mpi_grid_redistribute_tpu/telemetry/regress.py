@@ -82,6 +82,13 @@ GUARDED_METRICS: Dict[str, str] = {
     # schedule while pps holds. Auto-arms: skipped against histories
     # that predate the field (the PR 3 pattern).
     "exchange_wire_bytes_per_step": "lower",
+    # the closed-loop adaptive-rebalance leg's steady-state ms/step
+    # under sustained drift bias (bench.py "rebalance" key <-
+    # config4_drift.run_rebalance, loop ON): guards the whole
+    # plan->guard->apply path — a regression here means the one-shot
+    # remap stopped paying for itself. Auto-arms: skipped against
+    # histories that predate the field (the PR 3 pattern).
+    "rebalance_drift_ms": "lower",
 }
 
 # nested fallbacks: a metric missing at the top level of the parsed
@@ -94,6 +101,7 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "stress_bw_util": ("stress", "bw_util"),
     "soak_pps": ("soak", "value"),
     "exchange_wire_bytes_per_step": ("report", "wire_bytes_per_step"),
+    "rebalance_drift_ms": ("rebalance", "steady_ms_per_step"),
 }
 
 

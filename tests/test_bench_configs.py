@@ -72,6 +72,22 @@ def test_config4_drift():
     assert out["chips"] == 8  # 2x2x2 fits the 8 virtual CPU devices
 
 
+def test_config4_rebalance_smoke_gate():
+    # the `make rebalance-smoke` gate at a CI-sized leg: ALERT ->
+    # applied rebalance -> post-imbalance <= 1.1x, zero drops, and the
+    # particle set bit-identical to the no-rebalance twin. The
+    # steady-state ms/step win is regress-guarded at bench scale, not
+    # asserted at this size.
+    from mpi_grid_redistribute_tpu.bench import config4_drift
+
+    out = config4_drift.run_rebalance(n_local=512, steps=48)
+    assert out["alerts"] >= 1
+    assert out["rebalances_applied"] >= 1
+    assert out["post_rebalance_imbalance"] <= 1.1
+    assert out["dropped"] == 0
+    assert out["bit_identical"]
+
+
 def test_config5_deposit():
     from mpi_grid_redistribute_tpu.bench import config5_deposit
 

@@ -215,3 +215,91 @@ def test_api_coerces_raw_edges_and_balanced_for_validates_shape(rng):
         GridEdges.balanced_for(
             d, ProcessGrid((2, 2, 2)), rng.random((100, 2))
         )
+
+
+def test_balanced_for_roundtrip_containment(rng):
+    """Round-trip: bin with the balanced edges, then check every row
+    actually lies inside its assigned rank's edge-slab subdomain (after
+    the engine's periodic wrap) — the containment half of the
+    edges<->binning contract."""
+    d = Domain(0.0, 1.0, periodic=True)
+    g = ProcessGrid((3, 2, 2))
+    pos = (rng.random((20_000, 3)) ** 2).astype(np.float32)  # skewed
+    e = GridEdges.balanced_for(d, g, pos)
+    wrapped = np.remainder(pos, np.float32(1.0))
+    ranks = binning.rank_of_position(pos, d, g, xp=np, edges=e)
+    for r in range(g.nranks):
+        rows = wrapped[ranks == r]
+        assert rows.size, f"rank {r} got no rows from a balanced map"
+        lo, hi = e.subdomain_of_rank(r, g)
+        for a in range(3):
+            assert (rows[:, a] >= np.float32(lo[a])).all()
+            # the last slab owns its closing edge (clip semantics)
+            if hi[a] < d.hi[a]:
+                assert (rows[:, a] < np.float32(hi[a])).all()
+            else:
+                assert (rows[:, a] <= np.float32(hi[a])).all()
+
+
+def test_balanced_for_rank_of_cell_consistency(rng):
+    """The other half of the round-trip: a probe at each edge-cell's
+    center must bin to exactly ``grid.rank_of_cell(cell)`` — the edge
+    slabs and the Cartesian rank map name the same owners."""
+    d = Domain(0.0, 1.0, periodic=True)
+    g = ProcessGrid((2, 3, 2))
+    e = GridEdges.balanced_for(
+        d, g, (rng.random((30_000, 3)) ** 1.5).astype(np.float32)
+    )
+    cells = np.stack(
+        np.meshgrid(*[np.arange(s) for s in g.shape], indexing="ij"),
+        axis=-1,
+    ).reshape(-1, 3)
+    centers = np.empty((len(cells), 3), np.float32)
+    for a in range(3):
+        ax = np.asarray(e.edges[a], np.float64)
+        mid = (ax[:-1] + ax[1:]) / 2.0
+        centers[:, a] = mid[cells[:, a]]
+    got = binning.rank_of_position(centers, d, g, xp=np, edges=e)
+    want = np.asarray([g.rank_of_cell(tuple(c)) for c in cells], np.int32)
+    assert np.array_equal(got, want)
+
+
+def test_uniform_axes_detection():
+    lin = tuple(float(v) for v in np.linspace(0.0, 1.0, 9))
+    quant = (0.0, 0.1, 0.3, 0.35, 0.5, 0.62, 0.8, 0.9, 1.0)
+    e = GridEdges((lin, quant))
+    assert e.uniform_axes == (True, False)
+    # two-edge axes (one cell) are trivially uniform
+    assert GridEdges(((0.0, 1.0),)).uniform_axes == (True,)
+
+
+def test_uniform_fast_path_matches_digitize(rng):
+    """The floor-multiply fast path on exactly-linspace axes must be
+    bit-identical to the compare-sum digitize it replaces, on both
+    backends, including boundary hits."""
+    d = Domain(0.0, 1.0, ndim=2, periodic=True)
+    g = ProcessGrid((4, 4))
+    lin = tuple(float(v) for v in np.linspace(0.0, 1.0, 5))
+    uni = GridEdges((lin, lin))
+    assert uni.uniform_axes == (True, True)
+    # same VALUES but hand-typed: a non-linspace tuple of the same
+    # floats must still take SOME correct path
+    pos = rng.random((8192, 2)).astype(np.float32)
+    pos[:6, 0] = [0.0, 0.25, 0.5, 0.75, 1.0, 0.249999]
+    got_fast = binning.cell_of_position(pos, d, g, xp=np, edges=uni)
+    got_jx = np.asarray(
+        binning.cell_of_position(jnp.asarray(pos), d, g, edges=uni)
+    )
+    assert np.array_equal(got_fast, got_jx)
+    # reference: force the digitize path by hiding uniform_axes
+    ref = np.stack(
+        [
+            np.clip(
+                np.digitize(pos[:, a], np.asarray(lin[1:-1], np.float32)),
+                0, 3,
+            )
+            for a in range(2)
+        ],
+        axis=-1,
+    ).astype(np.int32)
+    assert np.array_equal(got_fast, ref)

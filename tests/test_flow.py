@@ -84,6 +84,40 @@ def test_flow_accumulator_hand_math():
     json.dumps(snap)  # journal-able
 
 
+def test_imbalance_gauge_empty_and_partial_population():
+    """Hand math for the zero/partial-population edges: an ALL-empty
+    system is perfectly balanced (1.0, not the old 0.0 never-fed
+    sentinel), and a SOME-ranks-empty population still reads max/mean —
+    the empty ranks push the ratio UP, they don't reset it."""
+    acc = FlowAccumulator()
+    assert acc.imbalance == 0.0  # never fed: the 0.0 sentinel stands
+    acc.update(np.zeros((2, 2), np.int64), population=[0, 0])
+    assert acc.imbalance == 1.0  # all-empty = balanced
+    assert acc.snapshot()["population"] == [0, 0]
+    # partial: [0, 6] -> mean 3, max 6 -> 2.0 (NOT 1.0, NOT 0.0)
+    acc.update(np.zeros((2, 2), np.int64), population=[0, 6])
+    assert acc.imbalance == pytest.approx(2.0)
+    assert acc.snapshot()["population"] == [0, 6]
+    # [S, R] population: only the LAST step's gauge sticks
+    acc.update(
+        np.zeros((2, 2, 2), np.int64), population=[[9, 1], [4, 4]]
+    )
+    assert acc.imbalance == pytest.approx(1.0)
+    assert acc.snapshot()["population"] == [4, 4]
+    with pytest.raises(ValueError, match="non-negative"):
+        acc.update(np.zeros((2, 2), np.int64), population=[3, -1])
+
+
+def test_snapshot_population_none_until_fed():
+    acc = FlowAccumulator()
+    acc.update(np.asarray([[0, 2], [1, 0]], np.int64))  # raw matrix,
+    # no population gauge rides along
+    snap = acc.snapshot()
+    assert snap["population"] is None
+    assert snap["imbalance"] == 0.0
+    json.dumps(snap)
+
+
 def test_top_pairs_ordering_diag_and_zeros():
     m = np.asarray([[9, 4, 0], [4, 9, 2], [0, 0, 9]])
     # diagonal excluded by default; tie (0,1) vs (1,0) breaks toward the
@@ -473,8 +507,6 @@ def test_recorder_monitor_overhead_under_2pct(rng, _devices):
     be noise against ms-scale device steps)."""
     import time
 
-    from mpi_grid_redistribute_tpu.telemetry import min_of_k
-
     grid = ProcessGrid((2, 2, 2))
     n_local = 2048
     n = grid.nranks * n_local
@@ -511,11 +543,29 @@ def test_recorder_monitor_overhead_under_2pct(rng, _devices):
             mon.evaluate()
         return time.perf_counter() - t0
 
-    base = min_of_k(lambda: sample(False), k=5)
-    observed = min_of_k(lambda: sample(True), k=5)
-    overhead = (observed["min"] - base["min"]) / base["min"]
+    # noise protocol: inside a full-suite run the loop itself wobbles
+    # by several ms (allocator/scheduler state left by hundreds of
+    # prior tests) — far above the sub-ms observe path under test, so
+    # a min-of-k difference is noise-dominated. Each observed sample
+    # is paired with an immediately preceding base sample (the pair
+    # shares the slow drift) and the MEDIAN pair delta rejects the
+    # occasional scheduler spike. GC is held off so a collection over
+    # the suite's accumulated heap is not billed to the observe path.
+    import gc
+
+    deltas = []
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(7):
+            b = sample(False)
+            o = sample(True)
+            deltas.append((o - b) / b)
+    finally:
+        gc.enable()
+    overhead = float(np.median(deltas))
     assert overhead <= 0.02, (
-        f"observatory overhead {overhead:.1%} > 2% "
-        f"(base {base['min']*1e3:.2f} ms, observed "
-        f"{observed['min']*1e3:.2f} ms for {steps} steps)"
+        f"observatory overhead {overhead:.1%} > 2% (median of "
+        f"{len(deltas)} paired samples, {steps}-step loop; deltas "
+        f"{[f'{d:.1%}' for d in deltas]})"
     )

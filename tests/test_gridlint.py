@@ -643,11 +643,23 @@ def test_g008_service_subsystem_is_marked_and_clean():
     from mpi_grid_redistribute_tpu.analysis.rules_service import _MARKER_RE
 
     svc = os.path.join(PACKAGE, "service")
-    for name in ("driver.py", "supervisor.py", "faults.py", "elastic.py"):
-        with open(os.path.join(svc, name), encoding="utf-8") as fh:
+    marked = [
+        os.path.join(svc, name)
+        for name in ("driver.py", "supervisor.py", "faults.py", "elastic.py")
+    ]
+    # the rebalance actuation runs inside the driver's health boundary —
+    # a swallowed fault there silently turns the closed loop off
+    marked.append(os.path.join(PACKAGE, "telemetry", "rebalance.py"))
+    for path in marked:
+        with open(path, encoding="utf-8") as fh:
             src = fh.read()
-        assert _MARKER_RE.search(src), f"{name} lost its service-path marker"
-    findings = run_gridlint([svc], root=REPO_ROOT, rules=["G008"])
+        assert _MARKER_RE.search(src), (
+            f"{os.path.basename(path)} lost its service-path marker"
+        )
+    findings = run_gridlint(
+        [svc, os.path.join(PACKAGE, "telemetry", "rebalance.py")],
+        root=REPO_ROOT, rules=["G008"],
+    )
     assert findings == [], findings
 
 

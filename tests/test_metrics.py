@@ -165,7 +165,8 @@ def _mixed_recorder():
                dropped=0, call=1)
     rec.record("mover_cap_grow", old=64, new=128, peak_movers=90)
     rec.record("flow_snapshot", steps=2, n_ranks=8, moved_rows_total=42,
-               imbalance=1.25, top_pairs=[[0, 1, 30]])
+               imbalance=1.25, population=[20, 12, 10, 8, 2, 0, 28, 20],
+               top_pairs=[[0, 1, 30]])
     return rec
 
 
@@ -192,6 +193,10 @@ def test_from_journal_hand_math():
     assert val("grid_alerts", rule="backlog_growth", severity="warn") == 1
     assert val("grid_flow_moved_rows") == 42
     assert val("grid_flow_imbalance") == 1.25
+    assert val("grid_rank_population", vrank="0") == 20
+    assert val("grid_rank_population", vrank="5") == 0
+    assert val("grid_rank_population", vrank="6") == 28
+    assert len(reg.get("grid_rank_population").children()) == 8
     st = reg.get("grid_step_time_seconds").labels()
     assert st.count == 2 and st.sum == pytest.approx(0.010)
     mv = reg.get("grid_movers_per_step").labels()
@@ -335,6 +340,28 @@ def test_render_openmetrics_passes_strict_parser():
     fam2, samp2 = _parse_openmetrics(text2)
     assert samp2["grid_flow_moved_rows"] == {}
     assert samp2["grid_population_rows"] == {}
+    assert samp2["grid_rank_population"] == {}
+
+
+def test_rank_population_latest_snapshot_wins():
+    """A later flow_snapshot replaces the per-vrank family outright —
+    including DROPPING ghost vranks when the rank count shrinks."""
+    rec = StepRecorder(host="h", pid=1)
+    rec.record("flow_snapshot", steps=1, n_ranks=4, moved_rows_total=0,
+               imbalance=2.0, population=[8, 0, 0, 0], top_pairs=[])
+    rec.record("flow_snapshot", steps=2, n_ranks=2, moved_rows_total=3,
+               imbalance=1.0, population=[4, 4], top_pairs=[])
+    reg = from_journal(rec)
+    fam = reg.get("grid_rank_population")
+    assert len(fam.children()) == 2
+    assert fam.labels(vrank="0").value == 4
+    assert fam.labels(vrank="1").value == 4
+    # a null population leaf (accumulator never fed one) is skipped,
+    # leaving the previous snapshot's family intact
+    rec.record("flow_snapshot", steps=3, n_ranks=2, moved_rows_total=3,
+               imbalance=1.0, population=None, top_pairs=[])
+    reg2 = from_journal(rec)
+    assert len(reg2.get("grid_rank_population").children()) == 2
 
 
 def test_label_value_escaping_round_trips():
@@ -652,7 +679,6 @@ def test_recorder_plus_metrics_overhead_under_2pct(rng, _devices):
     from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
     from mpi_grid_redistribute_tpu.telemetry import (
         FlowAccumulator,
-        min_of_k,
         record_flow_snapshot,
         record_migrate_steps,
     )
@@ -693,11 +719,25 @@ def test_recorder_plus_metrics_overhead_under_2pct(rng, _devices):
             assert text.rstrip().endswith("# EOF")
         return time.perf_counter() - t0
 
-    base = min_of_k(lambda: sample(False), k=5)
-    observed = min_of_k(lambda: sample(True), k=5)
-    overhead = (observed["min"] - base["min"]) / base["min"]
+    # median of paired base/observed deltas with GC held off, for the
+    # same reason as test_flow's overhead gate: the in-suite loop
+    # wobbles by several ms, so pairs share the slow drift and the
+    # median rejects scheduler spikes a min-of-k difference cannot
+    import gc
+
+    deltas = []
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(7):
+            b = sample(False)
+            o = sample(True)
+            deltas.append((o - b) / b)
+    finally:
+        gc.enable()
+    overhead = float(np.median(deltas))
     assert overhead <= 0.02, (
-        f"recorder+metrics overhead {overhead:.1%} > 2% "
-        f"(base {base['min']*1e3:.2f} ms, observed "
-        f"{observed['min']*1e3:.2f} ms for {steps} steps)"
+        f"recorder+metrics overhead {overhead:.1%} > 2% (median of "
+        f"{len(deltas)} paired samples, {steps}-step loop; deltas "
+        f"{[f'{d:.1%}' for d in deltas]})"
     )
