@@ -1,7 +1,7 @@
 PY ?= python
 
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
-	soak soak-smoke rebalance-smoke
+	soak soak-smoke rebalance-smoke service-bench
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -69,7 +69,18 @@ rebalance-smoke:
 	JAX_PLATFORMS=cpu \
 		$(PY) -m mpi_grid_redistribute_tpu.bench.config4_drift --rebalance
 
-# gridlint: AST-based SPMD/JIT invariant checker (G001-G008).
+# resident chunked-stepping gate (ISSUE 10): eager(chunk=1) vs chunked
+# (chunk=16/64) ServiceDriver pps on the 8-vrank CPU mesh (4096 rows,
+# one device — the measurement re-executes itself in a subprocess with
+# any device forcing stripped), asserting the chunk=64 speedup floor
+# (SERVICE_SPEEDUP_MIN, default 1.5x) and chunk-vs-eager final
+# particle-set bit-identity. service_pps is regress-guarded against
+# committed captures on top.
+service-bench:
+	JAX_PLATFORMS=cpu \
+		$(PY) -m mpi_grid_redistribute_tpu.bench.config10_service --gate
+
+# gridlint: AST-based SPMD/JIT invariant checker (G001-G009).
 # Exit 0 = clean or fully baselined; 1 = new findings or stale baseline
 # entries; 2 = usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:

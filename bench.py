@@ -279,6 +279,17 @@ def main() -> None:
 
         rebalance = config4_drift.run_rebalance()
 
+    # resident chunked-stepping capture (bench/config10_service.py):
+    # service-mode pps with lax.scan macro-steps vs the eager per-step
+    # loop — guards service_pps so the chunk path keeps paying for the
+    # host syncs it removed; runs in its own subprocess so the vrank
+    # topology is measured even under the 8-device forcing above
+    service = None
+    if os.environ.get("BENCH_SERVICE", "1") != "0":
+        from mpi_grid_redistribute_tpu.bench import config10_service
+
+        service = config10_service.run()
+
     print(
         json.dumps(
             {
@@ -320,6 +331,7 @@ def main() -> None:
                 "stress": stress,
                 "soak": soak,
                 "rebalance": rebalance,
+                "service": service,
                 # environment fingerprint (telemetry.regress): the
                 # classifier flags cross-capture deltas whose machine
                 # changed out from under them

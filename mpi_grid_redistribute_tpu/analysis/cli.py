@@ -41,6 +41,9 @@ _RULE_DOCS = {
     "modules (the metrics plane is host-only)",
     "G008": "no bare `except:` or swallowed exceptions in "
     "service-path-marked modules (the supervisor must see every fault)",
+    "G009": "no host syncs (np.asarray/.block_until_ready()/float() on "
+    "non-literals) inside resident-path-marked functions (chunk "
+    "interior stays on device)",
 }
 
 

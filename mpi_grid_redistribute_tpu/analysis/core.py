@@ -30,7 +30,10 @@ import os
 import re
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-RULE_IDS = ("G001", "G002", "G003", "G004", "G005", "G006", "G007", "G008")
+RULE_IDS = (
+    "G001", "G002", "G003", "G004", "G005", "G006", "G007", "G008",
+    "G009",
+)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*gridlint:\s*disable(?P<file>-file)?\s*=\s*"
@@ -711,6 +714,7 @@ def run_gridlint(
         rules_jit,
         rules_pallas,
         rules_planar,
+        rules_resident,
         rules_scrape,
         rules_service,
     )

@@ -1,0 +1,141 @@
+"""G009 — no host syncs inside resident-path-marked functions.
+
+The resident chunked service loop (ISSUE 10) exists to confine host
+round trips to chunk boundaries: the macro-step body traces ONCE into
+a ``lax.scan`` and advances ``chunk`` steps per dispatch with every
+per-step observable carried in-graph as scan ys. A host sync slipped
+into that body — an ``np.asarray`` on a carry leaf, a
+``.block_until_ready()``, a ``float(...)`` of a per-step counter —
+either fails at trace time on a tracer (the loud case) or, worse,
+executes once per DISPATCH at trace-cache misses and silently
+re-introduces the per-step stall the chunk engine was built to remove.
+Like G006's cost contract, the failure mode is invisible to
+correctness suites: every test still passes bit-for-bit, only the
+chunk-boundary sync profile quietly degrades back to eager.
+
+A function opts into the contract with a marker comment on the line
+directly above its ``def`` (above decorators, if any)::
+
+    # gridlint: resident-path
+    def macro(pos, vel, ids, count):
+        ...
+
+Inside a marked function (lexically, nested defs and lambdas included —
+the scan body is a nested def) the rule flags:
+
+* ``np.asarray`` / bare ``asarray`` calls — the canonical
+  device->host materialization (``jnp.asarray`` stays on device and is
+  fine, so only the numpy spellings are flagged);
+* any ``.block_until_ready()`` call — an explicit dispatch barrier has
+  no business inside a traced body;
+* ``float(...)`` / ``int(...)`` on a non-literal — on a tracer this is
+  a concretization error at best, a silent per-dispatch sync at worst;
+  observables belong in the scan ys, read at chunk boundaries.
+
+Like G001/G006 the check is lexical only — helpers CALLED from the
+body are not scanned; the jaxpr walk in ``tests/test_resident.py`` is
+the dynamic backstop asserting the traced macro carries no host
+callbacks through any call boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from mpi_grid_redistribute_tpu.analysis.core import (
+    Finding,
+    Project,
+    call_name,
+    last_attr,
+    rule,
+)
+
+_MARKER_RE = re.compile(r"#\s*gridlint:\s*resident-path\b")
+_NUMPY_HEADS = ("np", "numpy", "onp")
+_CAST_NAMES = ("float", "int")
+
+
+def _is_marked(fi, mod) -> bool:
+    node = fi.node
+    if isinstance(node, ast.Lambda):
+        return False
+    first = min(
+        [node.lineno] + [d.lineno for d in node.decorator_list]
+    )
+    if first < 2 or first - 2 >= len(mod.lines):
+        return False
+    return bool(_MARKER_RE.search(mod.lines[first - 2]))
+
+
+def _is_host_asarray(name: str) -> bool:
+    """``np.asarray``/``numpy.asarray``/bare ``asarray`` — NOT
+    ``jnp.asarray`` (a device op)."""
+    if not name or last_attr(name) != "asarray":
+        return False
+    head = name.split(".", 1)[0]
+    return head == "asarray" or head in _NUMPY_HEADS
+
+
+@rule("G009")
+def check_resident(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        for fi in mod.functions.values():
+            if not _is_marked(fi, mod):
+                continue
+            for call in ast.walk(fi.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = call_name(call)
+                tail = last_attr(name)
+                if _is_host_asarray(name):
+                    findings.append(
+                        Finding(
+                            "G009",
+                            mod.relpath,
+                            call.lineno,
+                            call.col_offset,
+                            "np.asarray inside resident-path-marked "
+                            "function — a device->host materialization "
+                            "in the chunk interior; read observables "
+                            "from the scan ys at chunk boundaries "
+                            "instead",
+                            fi.qualname,
+                        )
+                    )
+                elif tail == "block_until_ready":
+                    findings.append(
+                        Finding(
+                            "G009",
+                            mod.relpath,
+                            call.lineno,
+                            call.col_offset,
+                            "block_until_ready inside resident-path-"
+                            "marked function — an explicit dispatch "
+                            "barrier in the chunk interior; the driver "
+                            "blocks once per chunk, at the boundary",
+                            fi.qualname,
+                        )
+                    )
+                elif name in _CAST_NAMES:
+                    arg = call.args[0] if call.args else None
+                    if arg is not None and not isinstance(
+                        arg, ast.Constant
+                    ):
+                        findings.append(
+                            Finding(
+                                "G009",
+                                mod.relpath,
+                                call.lineno,
+                                call.col_offset,
+                                f"{name}() on a non-literal inside "
+                                f"resident-path-marked function — "
+                                f"concretizes a tracer (or syncs per "
+                                f"dispatch); carry the value as a scan "
+                                f"y and convert at the chunk boundary",
+                                fi.qualname,
+                            )
+                        )
+    return findings

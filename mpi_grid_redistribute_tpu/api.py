@@ -851,6 +851,158 @@ class GridRedistribute:
             out[0], tuple(out[2:-1]), out[1], out[-1]
         )
 
+    def engine_fn(self, positions, *fields):
+        """Hand out the resolved single-dispatch engine program.
+
+        Returns ``(fn, cap, out_cap)`` where
+        ``fn(positions, count, *fields) -> (positions, count, fields,
+        stats)`` is the SAME jitted engine :meth:`redistribute` would
+        dispatch for arrays of these shapes/dtypes — with no per-call
+        Python re-entry: no retry loop, no journal record, no stats
+        read. That makes it safe to invoke once per step inside a
+        ``lax.scan`` (the resident chunked service loop,
+        ``service/resident.py``). The overflow policy moves to the
+        CALLER's chunk boundary: read the scanned stats' drop counters
+        there, grow via :meth:`_grow` (a fresh ``engine_fn`` picks up
+        the grown capacities), and re-run the chunk on its unchanged
+        entry arrays.
+
+        Engine resolution, the ``engine_resolved`` journal event and the
+        scheduled-wire model (``_last_wire``) behave exactly as one
+        :meth:`redistribute` call would, so telemetry stays coherent.
+        """
+        if self.backend != "jax":
+            raise ValueError(
+                "engine_fn requires backend='jax' — the numpy oracle "
+                "has no jitted engine program to hand out"
+            )
+        R = self.nranks
+        if positions.ndim != 2 or positions.shape[0] % R:
+            raise ValueError(
+                f"positions must be [R*n_local, ndim] over {R} ranks, "
+                f"got {positions.shape}"
+            )
+        n_local = positions.shape[0] // R
+        cap, out_cap = self._capacities(n_local)
+        self._last_row_bytes = report_lib.row_bytes_of(positions, *fields)
+        specs = None
+        if self.engine in ("auto", "planar", "sparse", "neighbor"):
+            specs = _planar_specs(positions, fields)
+            if specs is None and self.engine in (
+                "planar", "sparse", "neighbor"
+            ):
+                raise TypeError(
+                    f"engine={self.engine!r} requires 32-bit positions "
+                    "and fields (they ride bitcast to float32 rows); "
+                    "cast or use engine='auto'/'rowmajor'"
+                )
+        n_dev = 1 if self._vranks else int(self.mesh.devices.size)
+        res_key = (self.engine, self._vranks, specs is not None, n_dev)
+        rec = None
+        if res_key != self._last_resolution:
+            self._last_resolution = res_key
+            rec = self.telemetry
+        resolved = exchange.resolve_engine(
+            self.engine, vranks=self._vranks, n_devices=n_dev,
+            planar_ok=specs is not None, canonical=True, recorder=rec,
+        )
+        dense_cols = R * cap
+        if resolved in ("sparse", "neighbor") and specs is not None:
+            B = self._mover_cap_for(cap)
+            if B >= cap:
+                if rec is None and self._last_wire is not None and (
+                    self._last_wire.get("engine") != "planar"
+                ):
+                    self.telemetry.record(
+                        "engine_resolved",
+                        requested=self.engine,
+                        resolved="planar",
+                        reason=(
+                            f"{resolved}: mover_cap {B} >= capacity "
+                            f"{cap}, count-driven pool no smaller than "
+                            f"dense"
+                        ),
+                        canonical=True,
+                    )
+                resolved = "planar"
+            else:
+                if resolved == "neighbor":
+                    engine_cols = B * _neighbor_active_offsets(
+                        self.grid, tuple(self.domain.periodic)
+                    )
+                else:
+                    engine_cols = R * B
+                self._last_wire = {
+                    "engine": resolved,
+                    "engine_cols": engine_cols,
+                    "dense_cols": dense_cols,
+                    "shards": R,
+                }
+                if self._vranks:
+                    fn = _build_count_driven_vranks_call(
+                        self.domain, self.grid, cap, out_cap, B, resolved,
+                        specs, edges=self.edges,
+                    )
+                else:
+                    fn = _build_count_driven_mesh_call(
+                        self.mesh, self.domain, self.grid, cap, out_cap,
+                        B, resolved, specs, edges=self.edges,
+                    )
+                return fn, cap, out_cap
+        self._last_wire = {
+            "engine": resolved,
+            "engine_cols": dense_cols,
+            "dense_cols": dense_cols,
+            "shards": R,
+        }
+        if resolved == "planar" and specs is not None:
+            if self._vranks:
+                fn = _build_planar_vranks_call(
+                    self.domain, self.grid, cap, out_cap, specs,
+                    edges=self.edges,
+                )
+            else:
+                fn = _build_planar_mesh_call(
+                    self.mesh, self.domain, self.grid, cap, out_cap, specs,
+                    edges=self.edges,
+                )
+            return fn, cap, out_cap
+        if self._vranks:
+            raw = exchange.build_redistribute_vranks(
+                self.domain, self.grid, cap, out_cap, self.edges
+            )
+
+            def fn(positions, count, *fields, _raw=raw, _R=R, _oc=out_cap):
+                n = positions.shape[0] // _R
+                out = _raw(
+                    positions.reshape(_R, n, -1),
+                    count,
+                    *(
+                        f.reshape((_R, n) + f.shape[1:]) for f in fields
+                    ),
+                )
+                unstack = lambda a: a.reshape(
+                    (_R * _oc,) + a.shape[2:]
+                )
+                return (
+                    unstack(out[0]),
+                    out[1],
+                    tuple(unstack(f) for f in out[2:-1]),
+                    out[-1],
+                )
+
+            return fn, cap, out_cap
+        raw = exchange.build_redistribute(
+            self.mesh, self.domain, self.grid, cap, out_cap, len(fields),
+            self.edges,
+        )
+
+        def fn(positions, count, *fields, _raw=raw):
+            out = _raw(positions, count, *fields)
+            return out[0], out[1], tuple(out[2:-1]), out[-1]
+
+        return fn, cap, out_cap
+
     def redistribute(self, positions, *fields, count=None) -> RedistributeResult:
         """Bin, pack, exchange: every particle moves to its owner shard.
 

@@ -1,0 +1,283 @@
+"""Config 10: resident chunked stepping — service-mode pps, eager vs chunked.
+
+Config 8 asks what durability costs; this one asks what the *per-step
+host round trip* costs (ISSUE 10). The eager ``ServiceDriver`` loop
+pays, every step: a full device->host materialization of the particle
+state, a numpy drift, a fresh engine dispatch, and a blocking read of
+the dropped counters. The resident chunked path
+(:mod:`~..service.resident`) advances ``chunk`` steps per dispatch
+inside one ``lax.scan`` and syncs the host only at chunk boundaries.
+This capture measures both legs through the SAME public driver — only
+``cfg.chunk`` differs — so the ratio is the price of per-step host
+syncs, nothing else.
+
+Shape: the 8-vrank CPU mesh — all eight ranks resident on ONE CPU
+device (``GridRedistribute``'s vrank path, no device forcing), 4096
+rows on the host (``DriverConfig.n_local = 512`` per vrank), slab
+decomposition, neighbor engine. This is deliberately the service
+shape where host overhead is an honest fraction of step time: per-step
+engine compute scales with rows, the eager loop's sync tax does not.
+On fatter per-rank populations the step goes compute-bound and the
+ratio tends to 1 — that regime is config 8's job, not this one's.
+
+The measurement runs in a **subprocess** with any
+``xla_force_host_platform_device_count`` forcing stripped from
+``XLA_FLAGS``: the repo's bench/test harnesses force 8 CPU devices,
+which would silently swap the vrank path for the shard_map mesh path
+and time a different program.
+
+Headline: ``service_pps`` (chunk=64 service throughput), guarded by
+``bench-check`` like any other capture (auto-armed: history captures
+that predate the field are skipped). ``speedup_vs_eager`` is the
+chunk=64 / chunk=1 ratio the acceptance gate (``make service-bench``)
+checks against ``SERVICE_SPEEDUP_MIN`` (default 1.5), alongside a
+chunk-vs-eager final-particle-set bit-identity audit
+(:func:`~..service.elastic.particle_set`) with a chunk length that
+does NOT divide the horizon, so boundary splitting is exercised.
+
+Env overrides: ``BENCH_SERVICE_ROWS`` (host rows, default 4096),
+``BENCH_SERVICE_GRID``, ``BENCH_SERVICE_ENGINE``, ``BENCH_SERVICE_K``
+(min-of-k samples), ``BENCH_SERVICE_SEG`` (steps per timed segment,
+must be a multiple of every measured chunk), ``BENCH_SERVICE_CHUNKS``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+from mpi_grid_redistribute_tpu.bench import common
+
+_CHILD_FLAG = "--child"
+
+
+def _knobs() -> dict:
+    grid = tuple(
+        int(x)
+        for x in os.environ.get("BENCH_SERVICE_GRID", "1,1,8").split(",")
+    )
+    rows = int(os.environ.get("BENCH_SERVICE_ROWS", 4096))
+    return {
+        "grid": grid,
+        "rows": rows,
+        "n_local": rows // math.prod(grid),
+        "engine": os.environ.get("BENCH_SERVICE_ENGINE", "neighbor"),
+        "k": int(os.environ.get("BENCH_SERVICE_K", 5)),
+        "seg": int(os.environ.get("BENCH_SERVICE_SEG", 128)),
+        "chunks": tuple(
+            int(x)
+            for x in os.environ.get("BENCH_SERVICE_CHUNKS", "16,64").split(",")
+        ),
+    }
+
+
+def _make_driver(kn, chunk: int, steps: int):
+    from mpi_grid_redistribute_tpu.service import DriverConfig, ServiceDriver
+
+    cfg = DriverConfig(
+        grid_shape=kn["grid"],
+        n_local=kn["n_local"],
+        steps=steps,
+        seed=13,
+        backend="jax",
+        engine=kn["engine"],
+        chunk=chunk,
+        snapshot_every=0,
+        health_every=0,
+        watchdog_s=0.0,
+    )
+    return ServiceDriver(cfg)
+
+
+def _measure_pps(kn, chunk: int) -> dict:
+    """min-of-k segment timing of the full driver loop at one chunk."""
+    from mpi_grid_redistribute_tpu.telemetry import regress
+
+    seg, k = kn["seg"], kn["k"]
+    if seg % chunk:
+        raise ValueError(
+            f"BENCH_SERVICE_SEG={seg} must be a multiple of chunk {chunk} "
+            "(a partial trailing chunk would bill compile-shape churn "
+            "to the steady-state sample)"
+        )
+    warm = max(8, 2 * chunk)
+    drv = _make_driver(kn, chunk, warm + k * seg)
+    drv.init_state()
+    drv.run(max_steps=warm)  # compile + caches
+
+    def _segment() -> float:
+        t0 = time.perf_counter()
+        drv.run(max_steps=seg)
+        return (time.perf_counter() - t0) / seg
+
+    sample = regress.min_of_k(_segment, k=k)
+    live = int(drv.cfg.fill * kn["n_local"]) * math.prod(kn["grid"])
+    drv.close()
+    return {
+        "pps": live / sample["min"],
+        "ms_per_step": sample["min"] * 1e3,
+        "spread": sample["spread"],
+        "k": sample["k"],
+        "rows_live": live,
+    }
+
+
+def _bit_identity(kn) -> bool:
+    """Final particle SET, eager vs a non-divisor chunk (splits at the
+    horizon), over a short fixed trajectory."""
+    from mpi_grid_redistribute_tpu.service import elastic as elastic_lib
+
+    steps = 24
+    states = []
+    for chunk in (1, 7):
+        drv = _make_driver(kn, chunk, steps)
+        drv.init_state()
+        drv.run()
+        states.append(elastic_lib.particle_set(*drv.state))
+        drv.close()
+    return states[0] == states[1]
+
+
+def _child_main() -> int:
+    """The measurement body — runs on whatever devices THIS process
+    sees (the parent launched us with the device forcing stripped, so:
+    one CPU device, eight vranks)."""
+    import jax
+
+    kn = _knobs()
+    eager = _measure_pps(kn, 1)
+    by_chunk = {c: _measure_pps(kn, c) for c in kn["chunks"]}
+    head_chunk = max(kn["chunks"])
+    head = by_chunk[head_chunk]
+    out = {
+        "metric": "service_pps",
+        "value": round(head["pps"], 2),
+        "unit": "particles/s",
+        "grid": list(kn["grid"]),
+        "rows": kn["rows"],
+        "n_local_per_vrank": kn["n_local"],
+        "rows_live": head["rows_live"],
+        "engine": kn["engine"],
+        "n_devices": len(jax.devices()),
+        "chunk": head_chunk,
+        "ms_per_step": round(head["ms_per_step"], 3),
+        "timing_spread": round(head["spread"], 4),
+        "timing_k": head["k"],
+        "eager_pps": round(eager["pps"], 2),
+        "eager_ms_per_step": round(eager["ms_per_step"], 3),
+        "speedup_vs_eager": round(head["pps"] / eager["pps"], 3),
+        "chunk_pps": {
+            str(c): round(r["pps"], 2) for c, r in by_chunk.items()
+        },
+        "chunk_speedups": {
+            str(c): round(r["pps"] / eager["pps"], 3)
+            for c, r in by_chunk.items()
+        },
+        "bit_identical": _bit_identity(kn),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def run() -> dict:
+    """One service capture, measured in a clean-topology subprocess."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "mpi_grid_redistribute_tpu.bench.config10_service",
+            _CHILD_FLAG,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"config10 child failed (exit {proc.returncode}):\n"
+            + proc.stderr[-2000:]
+        )
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    common.log(
+        f"config10: service {out['value']:.3e} pps at chunk="
+        f"{out['chunk']} ({out['ms_per_step']:.2f} ms/step) vs eager "
+        f"{out['eager_pps']:.3e} pps ({out['eager_ms_per_step']:.2f} "
+        f"ms/step) -> {out['speedup_vs_eager']:.2f}x on "
+        f"{out['rows']} rows / {len(out['grid'])}-axis grid "
+        f"{out['grid']} ({out['n_devices']} device(s)), "
+        f"bit_identical={out['bit_identical']}"
+    )
+    return out
+
+
+def _service_gate(out: dict, min_speedup: float = 1.5) -> list:
+    """The `make service-bench` verdict: hard failures as reasons."""
+    failures = []
+    if out["speedup_vs_eager"] < min_speedup:
+        failures.append(
+            f"chunk={out['chunk']} speedup {out['speedup_vs_eager']:.2f}x "
+            f"below the {min_speedup:.2f}x floor"
+        )
+    if not out["bit_identical"]:
+        failures.append(
+            "chunked final particle set is NOT identical to the eager run"
+        )
+    if out["n_devices"] != 1:
+        failures.append(
+            f"child saw {out['n_devices']} devices — the vrank path was "
+            "not measured (device forcing leaked into the subprocess)"
+        )
+    return failures
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if _CHILD_FLAG in argv:
+        return _child_main()
+
+    import argparse
+
+    p = argparse.ArgumentParser(prog="config10_service")
+    p.add_argument(
+        "--gate", action="store_true",
+        help="gate mode (make service-bench): assert speedup/identity",
+    )
+    p.add_argument(
+        "--min-speedup", type=float,
+        default=float(os.environ.get("SERVICE_SPEEDUP_MIN", 1.5)),
+    )
+    args = p.parse_args(argv)
+    out = run()
+    common.emit(out)
+    if not args.gate:
+        return 0
+    failures = _service_gate(out, args.min_speedup)
+    if failures:
+        for f in failures:
+            common.log(f"service-bench FAIL: {f}")
+        return 1
+    common.log(
+        f"service-bench OK: {out['speedup_vs_eager']:.2f}x >= "
+        f"{args.min_speedup:.2f}x, bit-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

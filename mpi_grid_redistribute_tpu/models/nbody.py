@@ -74,6 +74,21 @@ class DriftConfig:
     mover_cap: Optional[int] = None
 
 
+def service_drift(pos, vel, dt):
+    """One service-loop drift, in-graph: float32 advance + periodic wrap
+    with the SAME arithmetic as ``ServiceDriver._advance``'s host-side
+    numpy drift (``(p + v*dt) % 1.0`` then the ``>= 1.0`` clamp), so a
+    resident macro-step (``service/resident.py``) is bit-identical to
+    the eager loop for any chunk length. ``wrap_periodic`` is NOT used
+    here on purpose — its arithmetic differs in the last ulp near cell
+    edges, which is enough to re-home a particle."""
+    one = jnp.asarray(1.0, pos.dtype)
+    pos = (pos + vel * jnp.asarray(dt, pos.dtype)) % one
+    # float32 `%` can round a tiny negative up to exactly 1.0, which is
+    # outside the periodic domain [0, 1)
+    return jnp.where(pos >= one, pos - one, pos)
+
+
 def make_drift_step(cfg: DriftConfig, mesh: Mesh):
     """Build the jitted single-step function.
 

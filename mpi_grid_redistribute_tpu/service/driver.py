@@ -81,6 +81,16 @@ class DriverConfig:
     watchdog_s: float = 0.0   # wall budget per step; 0 = watchdog off
     health_every: int = 0     # extra health cadence; 0 = at snapshots only
     step_sleep: float = 0.0   # pacing, so external kills land mid-run
+    # resident chunked stepping (ISSUE 10): advance `chunk` steps per
+    # dispatch as ONE jitted lax.scan (service/resident.py) with the
+    # per-step observables carried in-graph as scan ys; the host reads
+    # back only the ys and the final carry at chunk boundaries, and
+    # snapshot/health/fault hooks land exactly there (the chunk is
+    # auto-split at the next scheduled boundary, so cadences and the
+    # deterministic fault matrix are honored bit-for-bit). chunk=1 is
+    # today's eager loop; the numpy oracle backend batches the same
+    # boundary bookkeeping without a device scan.
+    chunk: int = 1
     # elastic restore (ISSUE 8): re-shard a snapshot whose (nranks,
     # rows_per_shard) disagrees with this config onto the configured
     # grid in one canonical redistribute; off = clear ElasticRestoreError
@@ -98,6 +108,11 @@ class DriverConfig:
     # GridRedistribute.apply_assignment, journaling a `rebalance` event
     # whether it applied or declined (telemetry/SCHEMA.md)
     rebalance: bool = False
+    # health rules whose ALERT findings actuate the rebalance loop: the
+    # population-skew gauge (imbalance_ratio) and the queueing signal
+    # (backlog_growth, already ALERT severity in the stock rule set).
+    # The triggering rule is journaled on every `rebalance` event.
+    rebalance_on: Tuple[str, ...] = ("imbalance_ratio", "backlog_growth")
     rebalance_threshold: float = 2.0  # imbalance_ratio ALERT threshold
     rebalance_cells: int = 2          # fine cells per grid cell per axis
     rebalance_horizon: int = 256      # guard amortization horizon (steps)
@@ -151,6 +166,14 @@ class ServiceDriver:
         self._edges = None
         self._planner = None
         self._guard = None
+        # resident chunked stepping: compiled macro-step cache, keyed on
+        # everything that changes the traced program (chunk length,
+        # layout, capacities, mover block, edges, engine), plus the
+        # completion timestamp of the last retired chunk — the timing
+        # anchor that keeps per-step walls honest when chunk k+1 was
+        # dispatched before chunk k's host reads (async overlap)
+        self._chunk_cache = {}
+        self._chunk_done: Optional[float] = None
         self._install_slo_rules()
         self._install_rebalance_rule()
 
@@ -232,16 +255,22 @@ class ServiceDriver:
                 domain, grid, backend="numpy", **kwargs
             )
         else:
+            import jax
+
             from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
 
-            self._rd = GridRedistribute(
-                domain, grid, mesh=mesh_lib.make_mesh(grid), **kwargs
-            )
+            if len(jax.devices()) >= grid.nranks:
+                kwargs["mesh"] = mesh_lib.make_mesh(grid)
+            # else: fewer devices than ranks — the vrank path (all
+            # shards resident on one device, vmapped engine). The same
+            # service loop runs on a laptop CPU as on the full mesh.
+            self._rd = GridRedistribute(domain, grid, **kwargs)
         # one journal for the whole service: the engine's own events
         # (capacity_grow, overflow windows, redistribute) land in the
         # driver's ring, next to snapshot/restore/fault/restart events
         self._rd.telemetry = self.recorder
         self._rd.monitor = self.monitor
+        self._chunk_cache.clear()  # macro fns close over the old engine
 
     # ---------------------------------------------------------- state
 
@@ -521,12 +550,13 @@ class ServiceDriver:
         if self.cfg.rebalance:
             # actuate BEFORE the slo_ raise loop: a rebalance that fixes
             # the hot rank this boundary must not be pre-empted by a
-            # restart the imbalance itself provoked
+            # restart the imbalance itself provoked. Any configured
+            # trigger rule (population skew OR backlog growth) may fire
+            # the same plan->guard->apply pipeline; the `rebalance`
+            # event journals which one did.
+            trigger_on = set(self.cfg.rebalance_on)
             for f in verdict["findings"]:
-                if (
-                    f["rule"] == "imbalance_ratio"
-                    and f["severity"] == "ALERT"
-                ):
+                if f["rule"] in trigger_on and f["severity"] == "ALERT":
                     self._maybe_rebalance(f)
                     break
         for f in verdict["findings"]:
@@ -567,6 +597,7 @@ class ServiceDriver:
                 step=self.step,
                 applied=False,
                 reason="no live rows to balance",
+                rule=finding["rule"],
                 trigger=finding["reason"],
             )
             return
@@ -583,6 +614,7 @@ class ServiceDriver:
                 step=self.step,
                 applied=False,
                 reason=d.reason,
+                rule=finding["rule"],
                 trigger=finding["reason"],
                 old_imbalance=plan.old_imbalance,
                 projected_imbalance=plan.projected_imbalance,
@@ -618,6 +650,7 @@ class ServiceDriver:
             step=self.step,
             applied=True,
             reason=d.reason,
+            rule=finding["rule"],
             trigger=finding["reason"],
             old_imbalance=plan.old_imbalance,
             projected_imbalance=plan.projected_imbalance,
@@ -663,51 +696,278 @@ class ServiceDriver:
         verdict["snapshots_corrupt"] = self.snapshots_corrupt()
         return (503 if verdict["status"] == "ALERT" else 200), verdict
 
+    # -------------------------------------------- chunked run machinery
+
+    def _chunk_len_from(self, step: int, end: int) -> int:
+        """Steps the next chunk may advance from ``step``: ``cfg.chunk``
+        clipped to the horizon and auto-split at the next scheduled
+        snapshot/health boundary and the next fault-eligible step
+        (``FaultPlan.next_step``), so every boundary lands exactly where
+        the eager loop would put it. A fault eligible at ``step`` itself
+        forces a singleton chunk — the fault then fires (and is timed,
+        watchdogged, journaled) exactly as in the eager loop."""
+        cfg = self.cfg
+        n = min(max(1, int(cfg.chunk)), end - step)
+        if n > 1:
+            for every in (cfg.snapshot_every, cfg.health_every):
+                if every:
+                    n = min(n, every - step % every)
+        if n > 1 and self.faults:
+            nf = self.faults.next_step(step)
+            if nf is not None:
+                n = min(n, max(1, nf - step))
+        return max(1, n)
+
+    def _boundary_free(self, step: int) -> bool:
+        # True when completing `step` triggers no snapshot/health work
+        # and no fault is eligible there — the precondition for
+        # dispatching the chunk that starts at `step` before retiring
+        # its predecessor (async overlap)
+        cfg = self.cfg
+        if cfg.snapshot_every and step % cfg.snapshot_every == 0:
+            return False
+        if cfg.health_every and step % cfg.health_every == 0:
+            return False
+        if self.faults:
+            nf = self.faults.next_step(step)
+            if nf is not None and nf <= step:
+                return False
+        return True
+
+    def _resident_ok(self) -> bool:
+        # the scan carry needs out_capacity == n_local; a recv-side
+        # capacity grow breaks that invariant and pins the driver to the
+        # eager per-step loop (which handles ragged capacities)
+        rd = self._rd
+        return rd is not None and (
+            rd.out_capacity is None
+            or int(rd.out_capacity) == int(self.cfg.n_local)
+        )
+
+    def _macro_fn(self, n: int):
+        """Compiled ``n``-step macro fn (+ its capacities), cached on
+        everything that changes the traced program."""
+        from mpi_grid_redistribute_tpu.service import resident
+
+        rd = self._rd
+        pos, vel, ids, _ = self.state
+        key = (
+            n, pos.shape[0], rd.capacity, rd.out_capacity,
+            rd._mover_cap, rd.edges, self.engine,
+        )
+        entry = self._chunk_cache.get(key)
+        if entry is None:
+            entry = resident.make_chunk_fn(rd, self.cfg.dt, n,
+                                           pos, vel, ids)
+            self._chunk_cache[key] = entry
+        return entry
+
+    def _materialize_state(self) -> None:
+        # device carry -> host numpy, at chunk boundaries that need the
+        # bytes (snapshot/rebalance/run-exit); jax arrays are immutable,
+        # so a pre-dispatched next chunk keeps computing unaffected
+        st = self.state
+        if st is not None and not isinstance(st[0], np.ndarray):
+            self.state = (
+                np.asarray(st[0]),
+                np.asarray(st[1]),
+                np.asarray(st[2], np.int32),
+                np.asarray(st[3], np.int32),
+            )
+
+    def _finish_steps(self, n, compute_s, budget_s, dropped) -> None:
+        """Fold one completed chunk into the per-step surfaces: n
+        ``step_latency`` events (wall apportioned from the chunk,
+        dropped from the ys), the monitor's step-time samples, the
+        snapshot-cadence EMA, and the watchdog (chunk budget / chunk
+        length). ``cfg.step_sleep`` is excluded from ``compute_s`` (the
+        SLO/EMA wall) but included in ``budget_s`` (the watchdog's) —
+        pacing is not latency, but a stalled sleep is still a stall."""
+        from mpi_grid_redistribute_tpu import telemetry as telemetry_lib
+
+        cfg = self.cfg
+        per = compute_s / n
+        first = self.step + 1
+        self.step += n
+        for _ in range(n):
+            self.monitor.note_step_time(per)
+        telemetry_lib.record_chunk_steps(self.recorder, first, per, dropped)
+        self._last_dropped = int(dropped[-1])
+        for _ in range(n):
+            self._wall_ema = (
+                per if self._wall_ema is None
+                else 0.2 * per + 0.8 * self._wall_ema
+            )
+        per_budget = budget_s / n
+        if cfg.watchdog_s and per_budget > cfg.watchdog_s:
+            raise StallError(
+                f"step {self.step} took {per_budget:.3f}s "
+                f"(> {cfg.watchdog_s:.3f}s watchdog)"
+            )
+
+    def _run_boundary(self) -> None:
+        # snapshot/health hooks, on the step the chunk just ended at;
+        # _chunk_len_from guarantees chunks never straddle a boundary
+        cfg = self.cfg
+        if cfg.snapshot_every and self.step % cfg.snapshot_every == 0:
+            self._materialize_state()
+            path = self.snapshot()
+            self.faults.after_snapshot(self, path)
+            self._health_check()
+        elif cfg.health_every and self.step % cfg.health_every == 0:
+            self._materialize_state()
+            self._health_check()
+
+    def _run_chunk_eager(self, n: int, fire_faults: bool = True) -> None:
+        """Advance ``n`` steps through the eager per-step engine path
+        (``n=1`` is exactly the pre-chunking loop). Used for the numpy
+        oracle backend at any chunk length, for singleton chunks (fault
+        steps, chunk=1 configs), and as the self-healing fallback when a
+        resident chunk overflowed."""
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        if fire_faults:
+            self.faults.before_step(self)
+        self._materialize_state()
+        dropped = []
+        for _ in range(n):
+            self.state = self._advance(*self.state)
+            dropped.append(self._last_dropped)
+        compute = time.perf_counter() - t0
+        if cfg.step_sleep:
+            time.sleep(cfg.step_sleep * n)
+        budget = time.perf_counter() - t0
+        self._finish_steps(n, compute, budget, dropped)
+        self._run_boundary()
+
+    def _dispatch_chunk(self, n: int):
+        """Dispatch one resident macro-step (jax async dispatch: returns
+        immediately with futures for the carry and the ys)."""
+        self.faults.before_step(self)  # no-op by construction: any
+        # eligible injector forced a singleton chunk via _chunk_len_from
+        t0 = time.perf_counter()
+        self._ensure_built()
+        macro, cap, out_cap = self._macro_fn(n)
+        entry = self.state
+        carry, ys = macro(*entry)
+        return (n, t0, cap, out_cap, entry, carry, ys)
+
+    def _retire_chunk(self, pending, end: int):
+        """Block on a dispatched chunk's (tiny) ys, fold them into the
+        per-step surfaces, and run the boundary hooks. When the NEXT
+        chunk has no boundary work at its start, it is dispatched from
+        the in-flight carry BEFORE this chunk's host reads — journal,
+        metrics and snapshot serialization then overlap device compute.
+        Returns the pre-dispatched pending chunk (or None)."""
+        from mpi_grid_redistribute_tpu.service import resident
+
+        cfg = self.cfg
+        n, t0, cap, out_cap, entry, carry, ys = pending
+        step_after = self.step + n
+        nxt = None
+        if step_after < end and self._boundary_free(step_after):
+            n2 = self._chunk_len_from(step_after, end)
+            if n2 > 1:
+                t0b = time.perf_counter()
+                macro2, cap2, out2 = self._macro_fn(n2)
+                carry2, ys2 = macro2(*carry)
+                nxt = (n2, t0b, cap2, out2, carry, carry2, ys2)
+        # host sync point: materialize the per-step stats (tiny arrays)
+        stats = ys["stats"]
+        ds = np.asarray(stats.dropped_send)    # [n, R]
+        dr = np.asarray(stats.dropped_recv)    # [n, R]
+        now = time.perf_counter()
+        anchor = t0 if self._chunk_done is None else max(
+            t0, self._chunk_done
+        )
+        compute = now - anchor
+        if ds.any() or dr.any():
+            # overflow inside the chunk: the scanned steps ran at too
+            # small a capacity. Grow from the measured need, drop the
+            # chunk (and any pre-dispatched successor — it consumed the
+            # lossy carry), and re-run these n steps through the eager
+            # path, which heals exactly like redistribute() does.
+            counts = np.asarray(ys["count"])
+            needed = int(np.asarray(stats.needed_capacity).max())
+            needed_out = int((counts + dr).max())
+            self._rd._grow(
+                int(ds.sum()), int(dr.sum()), needed, needed_out,
+                int(self.cfg.n_local), cap, out_cap,
+            )
+            self._chunk_cache.clear()
+            self.state = entry
+            self._run_chunk_eager(n, fire_faults=False)
+            self._chunk_done = time.perf_counter()
+            return None
+        if cfg.step_sleep:
+            time.sleep(cfg.step_sleep * n)
+        budget = time.perf_counter() - anchor
+        self.state = carry
+        self._rd._last_stats = resident.final_stats(stats)
+        # per-step engine surface: the same `redistribute` journal event
+        # stream the eager loop emits (static per chunk: one resolved
+        # engine, one wire model)
+        rd = self._rd
+        wire = rd._last_wire or {}
+        wire_bytes = (
+            wire.get("engine_cols", 0)
+            * (rd._last_row_bytes or 0)
+            * wire.get("shards", 0)
+        )
+        for _ in range(n):
+            rd._call_index += 1
+            self.recorder.record(
+                "redistribute",
+                call=rd._call_index,
+                n_local=int(cfg.n_local),
+                capacity=cap,
+                out_capacity=out_cap,
+                engine=wire.get("engine", self.engine),
+                wire_bytes=wire_bytes,
+            )
+        dropped = (ds.sum(axis=1) + dr.sum(axis=1)).tolist()
+        self._finish_steps(n, compute, budget, dropped)
+        self._chunk_done = time.perf_counter()
+        self._run_boundary()
+        return nxt
+
     def run(self, max_steps: Optional[int] = None):
-        """Advance up to ``max_steps`` (default: to ``cfg.steps``)."""
+        """Advance up to ``max_steps`` (default: to ``cfg.steps``).
+
+        With ``cfg.chunk > 1`` on the jax backend the loop is resident:
+        each iteration dispatches one ``chunk``-step ``lax.scan`` macro
+        step (``service/resident.py``) and folds its scanned ys into
+        the per-step journal/SLO/health surfaces at the chunk boundary;
+        chunk k+1 is dispatched before blocking on chunk k's host reads
+        whenever no boundary work separates them. ``chunk=1`` (and the
+        numpy backend's per-step engine) reproduce the eager loop
+        bit-for-bit — including the final particle set for ANY chunk,
+        which the fault-matrix tests audit via
+        ``elastic.particle_set``."""
         cfg = self.cfg
         if self.state is None:
             self.init_state()
         end = cfg.steps
         if max_steps is not None:
             end = min(end, self.step + int(max_steps))
-        while self.step < end:
-            self._ensure_built()
-            t0 = time.perf_counter()
-            self.faults.before_step(self)
-            self.state = self._advance(*self.state)
-            if cfg.step_sleep:
-                time.sleep(cfg.step_sleep)
-            wall = time.perf_counter() - t0
-            self.step += 1
-            self.monitor.note_step_time(wall)
-            # the SLO surface: one step_latency event per step feeds the
-            # grid_step_latency_seconds / grid_dropped_rows histograms
-            # and the slo_* window rules (telemetry/SCHEMA.md)
-            self.recorder.record(
-                "step_latency",
-                step=self.step,
-                seconds=float(wall),
-                dropped=self._last_dropped,
-            )
-            self._wall_ema = (
-                wall if self._wall_ema is None
-                else 0.2 * wall + 0.8 * self._wall_ema
-            )
-            if cfg.watchdog_s and wall > cfg.watchdog_s:
-                raise StallError(
-                    f"step {self.step} took {wall:.3f}s "
-                    f"(> {cfg.watchdog_s:.3f}s watchdog)"
-                )
-            if (
-                cfg.snapshot_every
-                and self.step % cfg.snapshot_every == 0
-            ):
-                path = self.snapshot()
-                self.faults.after_snapshot(self, path)
-                self._health_check()
-            elif cfg.health_every and self.step % cfg.health_every == 0:
-                self._health_check()
+        pending = None
+        try:
+            while self.step < end:
+                self._ensure_built()
+                if pending is not None:
+                    pending = self._retire_chunk(pending, end)
+                    continue
+                n = self._chunk_len_from(self.step, end)
+                if (
+                    n == 1
+                    or cfg.backend != "jax"
+                    or not self._resident_ok()
+                ):
+                    self._run_chunk_eager(n)
+                    continue
+                pending = self._dispatch_chunk(n)
+        finally:
+            self._materialize_state()
         return self.state
 
     def close(self) -> None:
@@ -773,6 +1033,11 @@ def main(argv=None) -> int:
     p.add_argument("--sync-snapshots", action="store_true")
     p.add_argument("--watchdog", type=float, default=0.0)
     p.add_argument("--step-sleep", type=float, default=0.0)
+    p.add_argument(
+        "--chunk", type=int, default=1,
+        help="steps per resident macro-dispatch (lax.scan; jax backend; "
+             "1 = eager per-step loop)",
+    )
     p.add_argument(
         "--no-resume", action="store_true",
         help="ignore existing snapshots; start from the seeded state",
@@ -851,6 +1116,7 @@ def main(argv=None) -> int:
         journal_dir=args.journal_dir,
         watchdog_s=args.watchdog,
         step_sleep=args.step_sleep,
+        chunk=args.chunk,
         auto_reshard=not args.no_reshard,
         slo_latency_p99_s=args.slo_p99,
         rebalance=args.rebalance,

@@ -98,6 +98,13 @@ class CrashFault:
             os._exit(self.exit_code)
         raise InjectedCrash(f"injected crash at step {driver.step}")
 
+    def next_step(self, step: int) -> Optional[int]:
+        if self.step is None:
+            return step  # crash-loop: may fire at any step
+        if self.fired or self.step < step:
+            return None
+        return self.step
+
 
 class StallFault:
     """Sleep ``seconds`` inside step ``step`` — longer than the driver's
@@ -120,6 +127,11 @@ class StallFault:
             seconds=self.seconds,
         )
         time.sleep(self.seconds)
+
+    def next_step(self, step: int) -> Optional[int]:
+        if self.fired or self.step < step:
+            return None
+        return self.step
 
 
 class TornSnapshotFault:
@@ -168,6 +180,9 @@ class TornSnapshotFault:
                 f"injected crash after torn snapshot at step {driver.step}"
             )
 
+    def next_step(self, step: int) -> Optional[int]:
+        return step if self._crash_pending else None
+
 
 class JournalShardLossFault:
     """Delete the driver's exported journal shard at ``step``. The next
@@ -192,6 +207,12 @@ class JournalShardLossFault:
             "fault_injected", fault=self.kind, step=driver.step, path=path,
         )
         os.remove(path)
+
+    def next_step(self, step: int) -> Optional[int]:
+        # may keep waiting past self.step until a shard exists to delete
+        if self.fired:
+            return None
+        return max(step, self.step)
 
 
 class FallbackFloodFault:
@@ -221,6 +242,11 @@ class FallbackFloodFault:
         driver.recorder.record(
             "fast_path", step=driver.step, taken=0, movers=0,
         )
+
+    def next_step(self, step: int) -> Optional[int]:
+        if step >= self.start_step + self.steps:
+            return None
+        return max(step, self.start_step)
 
 
 class LatencySpikeFault:
@@ -257,6 +283,11 @@ class LatencySpikeFault:
             "step_latency", step=driver.step, seconds=self.seconds,
             dropped=0,
         )
+
+    def next_step(self, step: int) -> Optional[int]:
+        if self._left <= 0:
+            return None
+        return max(step, self.start_step)
 
 
 class DeviceLossFault:
@@ -313,6 +344,25 @@ class FaultPlan:
             hook = getattr(f, "after_snapshot", None)
             if hook is not None:
                 hook(driver, path)
+
+    def next_step(self, step: int) -> Optional[int]:
+        """Earliest step >= ``step`` at which any ``before_step`` hook
+        might act (``None`` = never again). The chunked driver bounds
+        every resident macro-step with this so no fault step ever falls
+        strictly inside a chunk — the deterministic fault matrix fires
+        at exactly the same steps for every chunk size. An injector that
+        has a ``before_step`` hook but no ``next_step`` probe answers
+        ``step`` conservatively: the driver then runs it eagerly, one
+        step per chunk, which is always correct."""
+        nxt: Optional[int] = None
+        for f in self.faults:
+            if getattr(f, "before_step", None) is None:
+                continue
+            probe = getattr(f, "next_step", None)
+            n = step if probe is None else probe(step)
+            if n is not None and (nxt is None or n < nxt):
+                nxt = n
+        return nxt
 
     def device_budget(self, driver) -> Optional[int]:
         """Surviving-device count the mesh would report at restore time:

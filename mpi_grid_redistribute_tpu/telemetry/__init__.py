@@ -62,6 +62,7 @@ from mpi_grid_redistribute_tpu.telemetry.recorder import (  # noqa: F401
     Event,
     StepRecorder,
     fast_path_hit_rate,
+    record_chunk_steps,
     record_fast_path_steps,
     record_migrate_steps,
 )

@@ -286,6 +286,36 @@ def record_fast_path_steps(
     return fp.shape[0] - start
 
 
+def record_chunk_steps(
+    recorder: StepRecorder,
+    first_step: int,
+    seconds_per_step: float,
+    dropped,
+) -> int:
+    """Fold one resident chunk's scanned ys into the per-step journal
+    surface: one ``step_latency`` event per step, with the wall
+    apportioned evenly from the chunk dispatch and the dropped-row
+    counts taken from the in-graph scan ys (``service/resident.py``).
+    Same host-transfer contract as :func:`record_migrate_steps`: the
+    caller passes already-fetched host values at a chunk boundary,
+    never device arrays from a hot loop. Steps are numbered
+    ``first_step, first_step + 1, ...`` — the post-increment numbering
+    the eager loop journals — so the SLO window rules and the
+    ``grid_step_latency_seconds`` / ``grid_dropped_rows`` histogram
+    scrape see an identical event stream for any chunk length. Returns
+    the number of events recorded."""
+    n = 0
+    for i, d in enumerate(dropped):
+        recorder.record(
+            "step_latency",
+            step=int(first_step) + i,
+            seconds=float(seconds_per_step),
+            dropped=int(d),
+        )
+        n += 1
+    return n
+
+
 def fast_path_hit_rate(recorder: StepRecorder) -> Optional[float]:
     """Fraction of retained ``fast_path`` events with ``taken=1``; None
     when no sparse-engine steps have been journaled."""

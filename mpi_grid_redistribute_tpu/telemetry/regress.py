@@ -89,6 +89,13 @@ GUARDED_METRICS: Dict[str, str] = {
     # remap stopped paying for itself. Auto-arms: skipped against
     # histories that predate the field (the PR 3 pattern).
     "rebalance_drift_ms": "lower",
+    # the resident chunked-stepping capture's service-mode throughput
+    # (bench.py "service" key <- config10_service, chunk=64 on the
+    # 8-vrank CPU mesh): guards the lax.scan macro-step path — a
+    # regression here means per-step host syncs crept back into the
+    # chunk interior. Auto-arms: skipped against histories that predate
+    # the field (the PR 3 pattern).
+    "service_pps": "higher",
 }
 
 # nested fallbacks: a metric missing at the top level of the parsed
@@ -102,6 +109,7 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "soak_pps": ("soak", "value"),
     "exchange_wire_bytes_per_step": ("report", "wire_bytes_per_step"),
     "rebalance_drift_ms": ("rebalance", "steady_ms_per_step"),
+    "service_pps": ("service", "value"),
 }
 
 

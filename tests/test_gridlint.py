@@ -663,6 +663,106 @@ def test_g008_service_subsystem_is_marked_and_clean():
     assert findings == [], findings
 
 
+# ---------------------------------------------------------------- G009
+
+
+def test_g009_fires_on_host_syncs_in_marked_fn(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import numpy as np
+
+    # gridlint: resident-path
+    def macro(pos, vel, count):
+        host = np.asarray(count)
+        pos.block_until_ready()
+        total = float(count.sum())
+        return host, total
+    """,
+        },
+        rules=["G009"],
+    )
+    assert rules_of(findings) == ["G009"], findings
+    assert len(findings) == 3
+    assert any("np.asarray" in f.message for f in findings)
+    assert any("block_until_ready" in f.message for f in findings)
+    assert any("float()" in f.message for f in findings)
+
+
+def test_g009_scans_nested_scan_body_and_spares_device_ops(tmp_path):
+    # the scan body is a nested def — lexically inside the marked
+    # function, so it IS scanned; jnp.asarray and float literals are
+    # device-safe and must not fire
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+
+    # gridlint: resident-path
+    def macro(pos, count):
+        def body(carry, _):
+            p, c = carry
+            p = p + jnp.asarray(1.0, p.dtype) * float(0.5)
+            c = int(3) + np.asarray(c)
+            return (p, c), c
+        return lax.scan(body, (pos, count), None, length=4)
+    """,
+        },
+        rules=["G009"],
+    )
+    assert rules_of(findings) == ["G009"], findings
+    assert len(findings) == 1
+    assert "np.asarray" in findings[0].message
+
+
+def test_g009_unmarked_fn_and_boundary_code_are_free(tmp_path):
+    # host syncs OUTSIDE marked functions are the chunk-boundary
+    # contract working as designed — no findings
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import numpy as np
+
+    def retire_chunk(ys):
+        dropped = np.asarray(ys["dropped"])
+        return float(dropped.sum())
+
+    # gridlint: resident-path
+    def macro(pos, count):
+        return pos, count
+    """,
+        },
+        rules=["G009"],
+    )
+    assert findings == [], findings
+
+
+def test_g009_repo_gate_resident_engine_is_marked_and_clean():
+    # the chunk engine must carry the resident-path marker (the static
+    # half of the no-per-step-host-sync gate; tests/test_resident.py's
+    # jaxpr walk is the dynamic half) and lint clean
+    from mpi_grid_redistribute_tpu.analysis.rules_resident import (
+        _MARKER_RE,
+    )
+
+    path = os.path.join(PACKAGE, "service", "resident.py")
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    marked = {
+        lines[i + 1].split("(")[0].replace("def ", "").strip()
+        for i, ln in enumerate(lines)
+        if _MARKER_RE.search(ln) and i + 1 < len(lines)
+    }
+    assert "macro" in marked, marked
+    findings = run_gridlint([path], root=REPO_ROOT, rules=["G009"])
+    assert findings == [], findings
+
+
 # ------------------------------------------------- suppressions, baseline
 
 
