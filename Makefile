@@ -1,7 +1,8 @@
 PY ?= python
 
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
-	soak soak-smoke rebalance-smoke service-bench
+	soak soak-smoke rebalance-smoke service-bench progcheck \
+	progcheck-baseline
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -80,11 +81,26 @@ service-bench:
 	JAX_PLATFORMS=cpu \
 		$(PY) -m mpi_grid_redistribute_tpu.bench.config10_service --gate
 
-# gridlint: AST-based SPMD/JIT invariant checker (G001-G009).
-# Exit 0 = clean or fully baselined; 1 = new findings or stale baseline
-# entries; 2 = usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
+# gridlint: AST-based SPMD/JIT invariant checker (G001-G009), then
+# progcheck: the semantic jaxpr analyzer (J000-J004) over the REAL
+# traced programs. Exit 0 = clean or fully baselined; 1 = new findings
+# or stale baseline entries; 2 = usage/parse error.
+# See mpi_grid_redistribute_tpu/analysis/.
 lint:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --check
+	$(PY) scripts/progcheck.py --check
+
+# progcheck alone: trace every registered SPMD program on the virtual
+# 8-device CPU mesh and gate J001-J004 plus the static wire/footprint
+# profile against analysis/progprofile_baseline.json. No chip, no
+# compile — make_jaxpr only.
+progcheck:
+	$(PY) scripts/progcheck.py --check
+
+# refresh the J004 static-cost baseline after an INTENTIONAL wire or
+# footprint change (justify the delta in the commit message)
+progcheck-baseline:
+	$(PY) scripts/progcheck.py --update-baseline
 
 lint-json:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --format=json

@@ -211,6 +211,7 @@ def time_cpu_oracle(n_total: int, migration: float, n_steps: int = 5,
 def main() -> None:
     import jax
 
+    from mpi_grid_redistribute_tpu.analysis import baseline as baseline_lib
     from mpi_grid_redistribute_tpu.telemetry import regress
     from mpi_grid_redistribute_tpu.utils import profiling
 
@@ -336,6 +337,11 @@ def main() -> None:
                 # classifier flags cross-capture deltas whose machine
                 # changed out from under them
                 "env": regress.env_fingerprint(),
+                # progcheck static wire-model hash (analysis.baseline):
+                # lets bench_check tell a perf delta that coincides with
+                # an intentional wire/footprint change from one that
+                # doesn't (see classify_capture's drift note)
+                "progprofile_hash": baseline_lib.progprofile_hash(),
             }
         )
     )

@@ -39,7 +39,22 @@ Suppress a finding with a same-line comment ``# gridlint: disable=G00x``
 ``# gridlint: disable-file=G00x``. Grandfathered findings live in the
 committed baseline file ``analysis/gridlint_baseline.json``.
 
-CLI: ``python scripts/gridlint.py [paths] [--format=json] [--check]``.
+CLI: ``python scripts/gridlint.py [paths] [--format=json] [--check]``
+(also ``--format=sarif``/``--format=github`` and ``--check-baseline``
+for suppression hygiene).
+
+The G-rules read SOURCE. Their semantic complement is **progcheck**
+(``analysis/progcheck.py`` + ``analysis/rules_jaxpr.py``): J-rules
+J000–J004 that trace the REAL programs with ``jax.make_jaxpr`` and
+verify what was actually staged — collective-schedule consistency
+across ``lax.cond`` branches (J001), no host syncs in resident-marked
+programs (J002), the fast-path cost contracts (J003), and a static
+wire/footprint profile gated against
+``analysis/progprofile_baseline.json`` (J004). CLI:
+``python scripts/progcheck.py --check`` (``make progcheck``). progcheck
+is NOT imported here: this package root must stay importable without
+jax (gridlint and the baseline helpers run host-only), so pull it in
+explicitly via ``mpi_grid_redistribute_tpu.analysis.progcheck``.
 """
 
 from mpi_grid_redistribute_tpu.analysis.core import (

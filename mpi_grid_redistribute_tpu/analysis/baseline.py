@@ -10,15 +10,17 @@ file. Every entry must carry a human-written ``justification``.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Iterable, List, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from mpi_grid_redistribute_tpu.analysis.core import Finding
 
 BaselineKey = Tuple[str, str, str, str]
 
 _BASELINE_NAME = "gridlint_baseline.json"
+_PROGPROFILE_NAME = "progprofile_baseline.json"
 
 
 def default_baseline_path() -> str:
@@ -75,6 +77,79 @@ def write_baseline(
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=2, sort_keys=False)
         fh.write("\n")
+
+
+# ---------------------------------------------------------------------
+# progcheck's J004 profile baseline (analysis/progprofile_baseline.json)
+#
+# Unlike the gridlint baseline (a suppression list), this one is a
+# MEASUREMENT: the static wire/footprint profile of every registered
+# program, compared exactly (bench_check-style drift gate) by
+# ``rules_jaxpr.compare_profiles``. These helpers are jax-free on
+# purpose — bench.py embeds ``progprofile_hash()`` in its captures so
+# ``telemetry.regress`` can correlate a perf delta with a wire-model
+# change without importing the analyzer.
+# ---------------------------------------------------------------------
+
+
+def progprofile_baseline_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), _PROGPROFILE_NAME
+    )
+
+
+def load_progprofile_baseline(
+    path: Optional[str] = None,
+) -> Optional[Dict[str, dict]]:
+    """name -> profile dict, or ``None`` when the file doesn't exist
+    yet (progcheck then reports every program as unbaselined rather
+    than crashing — same loud-but-recoverable posture as gridlint's
+    malformed-baseline SystemExit)."""
+    path = path or progprofile_baseline_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    profiles = data.get("profiles")
+    if not isinstance(profiles, dict):
+        raise SystemExit(
+            f"progcheck: malformed profile baseline {path}: expected a "
+            "top-level 'profiles' object — regenerate with "
+            "--update-baseline"
+        )
+    return profiles
+
+
+def write_progprofile_baseline(
+    path: Optional[str], profiles: Dict[str, dict]
+) -> None:
+    path = path or progprofile_baseline_path()
+    payload = {
+        "comment": (
+            "progcheck J004 baseline: the static wire/footprint profile "
+            "(collective bytes, peak live-buffer estimate) of every "
+            "registered program, computed from jaxpr shapes x itemsize. "
+            "Deterministic for a fixed program: any drift is a real "
+            "cost-model change. Refresh with "
+            "`python scripts/progcheck.py --update-baseline` and justify "
+            "the delta in the commit message."
+        ),
+        "profiles": {k: profiles[k] for k in sorted(profiles)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def progprofile_hash(path: Optional[str] = None) -> Optional[str]:
+    """Short content hash of the committed profile baseline (None when
+    absent). Captured by bench.py so regress can flag 'the static wire
+    model changed between these captures'."""
+    path = path or progprofile_baseline_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()[:16]
 
 
 def split_baselined(

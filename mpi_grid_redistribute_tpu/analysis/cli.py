@@ -61,9 +61,10 @@ def _parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif", "github"),
         default="text",
-        help="output format",
+        help="output format (sarif: SARIF 2.1.0 for code-scanning "
+        "upload; github: ::warning workflow-command annotation lines)",
     )
     p.add_argument(
         "--rules",
@@ -91,6 +92,12 @@ def _parser() -> argparse.ArgumentParser:
         "--check",
         action="store_true",
         help="CI mode: additionally fail on stale baseline entries",
+    )
+    p.add_argument(
+        "--check-baseline",
+        action="store_true",
+        help="baseline hygiene only: report stale baseline entries (no "
+        "longer matching any finding) without gating new findings",
     )
     p.add_argument(
         "--root",
@@ -141,9 +148,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     new, grandfathered = split_baselined(findings, baseline)
 
     stale: List[tuple] = []
-    if args.check and baseline:
+    if (args.check or args.check_baseline) and baseline:
         matched = {f.baseline_key() for f in grandfathered}
         stale = sorted(baseline - matched)
+
+    if args.check_baseline:
+        # hygiene-only mode: stale suppressions rot silently unless
+        # something gates them on their own — new findings are gridlint
+        # --check's job, not this one's
+        for key in stale:
+            print(
+                f"stale baseline entry (code fixed? remove it): "
+                f"{key[0]} {key[1]} [{key[2]}]"
+            )
+        print(
+            f"gridlint: {len(stale)} stale baseline entr(y/ies) of "
+            f"{len(baseline)}"
+        )
+        return 1 if stale else 0
 
     if args.format == "json":
         print(
@@ -156,6 +178,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 indent=2,
             )
         )
+    elif args.format in ("sarif", "github"):
+        from mpi_grid_redistribute_tpu.analysis import sarif as sarif_lib
+
+        if args.format == "sarif":
+            print(
+                json.dumps(
+                    sarif_lib.to_sarif(new, "gridlint", _RULE_DOCS),
+                    indent=2,
+                )
+            )
+        else:
+            for line in sarif_lib.github_annotations(new):
+                print(line)
+        # stale entries have no source location to annotate; keep them
+        # visible (and exit-code-relevant) on stderr
+        for key in stale:
+            print(
+                f"stale baseline entry (code fixed? remove it): "
+                f"{key[0]} {key[1]} [{key[2]}]",
+                file=sys.stderr,
+            )
     else:
         for f in new:
             print(f.render())

@@ -1,0 +1,745 @@
+"""progcheck — semantic jaxpr analyzer for the REAL compiled programs.
+
+gridlint (``analysis/core.py``) is lexical: it reasons about source the
+way a reviewer does, without executing anything. That leaves a bug
+class it structurally cannot see — properties of the *traced* program:
+whether the two branches of the one-scalar dispatch ``lax.cond`` issue
+the same collective schedule, whether a host callback snuck into the
+resident macro-step through three layers of helpers, whether the
+"fast" branch of a count-driven engine quietly re-acquired a sort or a
+resident-scale gather after a refactor. progcheck closes that gap by
+tracing the registered entry points with ``jax.make_jaxpr`` (CPU-only,
+no chip, no compile) and checking invariants on the recursively walked
+jaxpr:
+
+========  ==============================================================
+J000      registry completeness: every exchange engine × topology
+          (sharded / vranks), the resident macro-step, the migrate
+          fast path and the apply_assignment one-shot must have a
+          registered program — new engines register or fail.
+J001      collective-schedule consistency: every ``lax.cond`` /
+          ``lax.switch`` whose branches contain collectives must either
+          issue identical ordered collective signatures (primitive +
+          axes + operand shape/dtype) in every branch, or take its
+          predicate from a provably replicated value (descended from a
+          ``psum``/``pmin``/``pmax``/``all_gather`` — the one-scalar-
+          cond discipline). Anything else is an SPMD desync/deadlock.
+J002      resident purity: programs marked resident must trace to pure
+          device code — no ``*callback*``, ``infeed``, ``outfeed`` or
+          ``debug_*`` primitive anywhere (the dynamic backstop behind
+          gridlint G009).
+J003      fast-path cost contract: count-driven fast branches keep the
+          mover-scale economics — the dispatch cond exists, migrate
+          fast branches are sort-free with statically bounded gathers,
+          the sparse wire rides mover-cap columns (never the dense
+          pool width), the neighbor wire is ppermute-only with NO
+          dense ``all_to_all``.
+J004      static wire/footprint drift gate: per-program collective
+          byte totals (scan trip counts folded in, cond billed at the
+          max-bytes branch) and peak live-buffer estimates, computed
+          from jaxpr shapes × itemsize and gated against the committed
+          ``analysis/progprofile_baseline.json`` — a cost regression
+          fails at trace time, before any chip sees it.
+========  ==============================================================
+
+The walk helpers (:func:`walk_eqns`, :func:`primitive_names`,
+:func:`dispatch_conds`, ...) are the PUBLIC API the test suite uses —
+they replace the three copies of ``_walk_eqns`` that used to live in
+``tests/test_migrate_sparse.py`` / ``test_exchange_sparse.py`` /
+``test_resident.py``.
+
+CLI: ``python scripts/progcheck.py [--format=json|sarif|github]
+[--check] [--update-baseline]`` — exit codes mirror gridlint (0 clean,
+1 findings/drift, 2 usage error). ``make progcheck`` wires it into
+``make lint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+J_RULE_IDS = ("J000", "J001", "J002", "J003", "J004")
+
+
+# ---------------------------------------------------------------------
+# jaxpr walk API (public; shared with the test suite)
+# ---------------------------------------------------------------------
+
+
+def jaxpr_of(obj):
+    """The open ``Jaxpr`` behind a ``ClosedJaxpr``/``Jaxpr``/traced fn
+    result — anything exposing ``.eqns`` directly or via ``.jaxpr``.
+    The ``.jaxpr`` unwrap comes first: ``ClosedJaxpr`` forwards
+    ``.eqns`` but not ``.invars``/``.constvars``."""
+    if hasattr(obj, "jaxpr"):
+        return obj.jaxpr
+    if hasattr(obj, "eqns"):
+        return obj
+    raise TypeError(f"not a jaxpr: {type(obj).__name__}")
+
+
+def as_jaxprs(value) -> List:
+    """Every jaxpr carried (possibly nested in lists/tuples) by one eqn
+    param value — cond ``branches``, scan/pjit ``jaxpr``, etc."""
+    if hasattr(value, "eqns"):
+        return [value]
+    if hasattr(value, "jaxpr"):
+        return [value.jaxpr]
+    if isinstance(value, (list, tuple)):
+        return [j for v in value for j in as_jaxprs(v)]
+    return []
+
+
+def subjaxprs(eqn) -> Iterator:
+    """The sub-jaxprs an eqn carries in its params (scan bodies, cond
+    branches, pjit/shard_map calls), in param order."""
+    for v in eqn.params.values():
+        yield from as_jaxprs(v)
+
+
+def walk_eqns(jaxpr) -> Iterator:
+    """Every eqn in ``jaxpr`` and its nested jaxprs, depth-first —
+    pjit/scan/cond/shard_map bodies alike. Accepts closed or open
+    jaxprs."""
+    j = jaxpr_of(jaxpr)
+    for eqn in j.eqns:
+        yield eqn
+        for sub in subjaxprs(eqn):
+            yield from walk_eqns(sub)
+
+
+def primitive_names(jaxpr) -> List[str]:
+    """Every primitive name in the (recursively walked) jaxpr, in
+    depth-first order (duplicates preserved)."""
+    return [e.primitive.name for e in walk_eqns(jaxpr)]
+
+
+def primitive_set(jaxpr) -> set:
+    return {e.primitive.name for e in walk_eqns(jaxpr)}
+
+
+def has_primitive(jaxpr, name: str) -> bool:
+    return any(e.primitive.name == name for e in walk_eqns(jaxpr))
+
+
+def branch_jaxprs(eqn) -> List:
+    """The branch jaxprs of a cond/switch eqn, opened."""
+    return [jaxpr_of(b) for b in eqn.params["branches"]]
+
+
+def dispatch_conds(jaxpr, flag: Callable[[object], bool]) -> List[Tuple]:
+    """Cond eqns whose branches DISAGREE about ``flag(branch_jaxpr)`` —
+    the engine-dispatch cond's structural signature (the fast and dense
+    branches differ by construction). Returns ``(eqn, fast, flagged)``
+    triples where ``fast`` is the branch with ``flag(...) == False``.
+
+    Only two-way disagreements qualify: a switch whose branches all
+    agree is not a dispatch site, and >2-way flags would be ambiguous.
+    """
+    out = []
+    for eqn in walk_eqns(jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = branch_jaxprs(eqn)
+        flags = [bool(flag(b)) for b in branches]
+        if len(set(flags)) == 2:
+            out.append(
+                (
+                    eqn,
+                    branches[flags.index(False)],
+                    branches[flags.index(True)],
+                )
+            )
+    return out
+
+
+def aval_bytes(aval) -> int:
+    """Static byte size of one abstract value (0 for tokens etc.)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape)) * np.dtype(dtype).itemsize if shape else np.dtype(dtype).itemsize
+
+
+# ---------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgFinding:
+    """One semantic-rule violation in one traced program."""
+
+    rule: str
+    program: str
+    message: str
+    # synthetic location so shared formatters (SARIF/github) can anchor
+    # the finding somewhere clickable: the registry module itself
+    path: str = "mpi_grid_redistribute_tpu/analysis/progcheck.py"
+    line: int = 1
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"<{self.program}>: {self.rule}: {self.message}"
+
+
+# ---------------------------------------------------------------------
+# program registry
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One traceable entry point progcheck guards.
+
+    ``build()`` returns ``(fn, example_args)``; the program under
+    analysis is ``jax.make_jaxpr(fn)(*example_args)``. Building must
+    only TRACE — never execute device code — so progcheck stays a
+    CPU-cheap trace-time gate.
+    """
+
+    name: str
+    build: Callable[[], Tuple[Callable, tuple]]
+    description: str = ""
+    engine: Optional[str] = None  # exchange.ENGINES member it exercises
+    topology: Optional[str] = None  # "sharded" | "vranks"
+    resident: bool = False  # J002 applies
+    fastpath: Optional[str] = None  # "migrate"|"sparse_wire"|"neighbor_wire"
+    resident_rows: Optional[int] = None  # J003 gather bound (migrate kind)
+    capacity: Optional[int] = None  # J003 width relation (sparse_wire)
+    mover_cap: Optional[int] = None
+    tags: Tuple[str, ...] = ()
+
+
+PROGRAMS: Dict[str, ProgramSpec] = {}
+
+
+def register_program(spec: ProgramSpec) -> ProgramSpec:
+    if spec.name in PROGRAMS:
+        raise ValueError(f"program {spec.name!r} already registered")
+    PROGRAMS[spec.name] = spec
+    return spec
+
+
+def trace_program(spec: ProgramSpec):
+    """The program's ClosedJaxpr (trace only; nothing executes)."""
+    import jax
+
+    fn, args = spec.build()
+    return jax.make_jaxpr(fn)(*args)
+
+
+# -- the default registry: every engine the repo can dispatch ----------
+
+_SHARDED_GRID = (2, 2, 2)  # 8 ranks, one per forced host device
+_VRANK_GRID = (2, 2, 4)  # 16 ranks > 8 devices -> vmapped vranks
+_N_LOCAL = 32
+_CAPACITY = 16
+_MOVER_CAP = 4
+
+
+def _require_devices(n: int = 8):
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < n:
+        raise SystemExit(
+            f"progcheck: needs >= {n} devices to trace the sharded "
+            f"programs, got {len(devs)} — run via scripts/progcheck.py "
+            "(it forces the virtual CPU mesh) or set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    return devs
+
+
+def _mk_rd(engine: str, topology: str, edges=None):
+    from mpi_grid_redistribute_tpu import api
+    from mpi_grid_redistribute_tpu.domain import ProcessGrid
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    if topology == "sharded":
+        devs = _require_devices()
+        grid = ProcessGrid(_SHARDED_GRID)
+        mesh = mesh_lib.make_mesh(grid, devs[: grid.nranks])
+    else:
+        grid = ProcessGrid(_VRANK_GRID)
+        mesh = None
+    return api.GridRedistribute(
+        grid=grid,
+        lo=(0.0,) * 3,
+        hi=(1.0,) * 3,
+        periodic=(True,) * 3,
+        engine=engine,
+        mesh=mesh,
+        capacity=_CAPACITY,
+        mover_cap=_MOVER_CAP if engine in ("sparse", "neighbor") else None,
+        edges=edges,
+    )
+
+
+def _canonical_build(engine: str, topology: str, edges_fn=None):
+    """Builder for one canonical-exchange program: the exact jitted
+    engine ``GridRedistribute.engine_fn`` resolves — what
+    ``redistribute()`` dispatches — traced on template arrays."""
+
+    def build():
+        import jax.numpy as jnp
+
+        edges = edges_fn() if edges_fn is not None else None
+        rd = _mk_rd(engine, topology, edges=edges)
+        R = rd.nranks
+        pos = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
+        ids = jnp.zeros((R * _N_LOCAL,), jnp.int32)
+        count = jnp.full((R,), _N_LOCAL, jnp.int32)
+        fn, _cap, _out_cap = rd.engine_fn(pos, ids)
+        return fn, (pos, count, ids)
+
+    return build
+
+
+def _assignment_edges():
+    """Assignment-aware fine-grid edges for the sharded grid — the same
+    LPT-map shape ``apply_assignment`` installs at runtime (fine 4^3
+    cells, each mapped to the rank of its coarse cell)."""
+    from mpi_grid_redistribute_tpu.domain import GridEdges, ProcessGrid
+
+    grid = ProcessGrid(_SHARDED_GRID)
+    fine = 4
+    edges = tuple(
+        tuple(float(v) for v in np.linspace(0.0, 1.0, fine + 1))
+        for _ in range(3)
+    )
+    assignment = []
+    for i in range(fine):
+        for j in range(fine):
+            for k in range(fine):
+                coarse = (
+                    i * grid.shape[0] // fine,
+                    j * grid.shape[1] // fine,
+                    k * grid.shape[2] // fine,
+                )
+                assignment.append(grid.rank_of_cell(coarse))
+    return GridEdges(edges, assignment=assignment)
+
+
+def _migrate_build(engine: str, topology: str):
+    """Builder for a drift/migrate loop program (the fast-path jaxpr
+    contract previously asserted only inside test_migrate_sparse)."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+        from mpi_grid_redistribute_tpu.models import nbody
+        from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+        domain = Domain(0.0, 1.0, periodic=True)
+        n_local = 64
+        if topology == "sharded":
+            devs = _require_devices()
+            dev_grid, vgrid = ProcessGrid(_SHARDED_GRID), None
+            mesh = mesh_lib.make_mesh(dev_grid, devs[: dev_grid.nranks])
+        else:
+            dev_grid, vgrid = ProcessGrid((1, 1, 1)), ProcessGrid((2, 2, 2))
+            mesh = mesh_lib.make_mesh(dev_grid)
+        cfg = nbody.DriftConfig(
+            domain=domain,
+            grid=dev_grid,
+            dt=0.07,
+            capacity=n_local,
+            n_local=n_local,
+            engine=engine,
+            mover_cap=16 if engine == "sparse" else None,
+        )
+        loop = nbody.make_migrate_loop(cfg, mesh, 3, vgrid=vgrid)
+        n = (vgrid.nranks if vgrid else dev_grid.nranks) * n_local
+        pos = jnp.zeros((3 * n,), jnp.float32)  # planar-flat layout
+        vel = jnp.zeros((3 * n,), jnp.float32)
+        alive = jnp.zeros((n,), bool)
+        return loop, (pos, vel, alive)
+
+    return build
+
+
+def _resident_build():
+    """Builder for the resident chunk macro-step — the exact jitted
+    ``lax.scan`` program ``ServiceDriver`` dispatches per chunk."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from mpi_grid_redistribute_tpu.service import resident
+
+        rd = _mk_rd("auto", "vranks")
+        R = rd.nranks
+        pos = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
+        vel = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
+        ids = jnp.zeros((R * _N_LOCAL,), jnp.int32)
+        count = jnp.full((R,), _N_LOCAL, jnp.int32)
+        macro, _cap, _out_cap = resident.make_chunk_fn(
+            rd, 0.05, 4, pos, vel, ids
+        )
+        assert getattr(
+            macro.__wrapped__, "_progcheck_resident", False
+        ), "make_chunk_fn lost its resident-path marker"
+        return macro, (pos, vel, ids, count)
+
+    return build
+
+
+_DEFAULTS_BUILT = False
+
+
+def _register_defaults() -> None:
+    """Populate :data:`PROGRAMS` with every traceable entry point. Kept
+    lazy so importing this module never touches jax device init (the
+    walk helpers must stay importable everywhere the tests run)."""
+    global _DEFAULTS_BUILT
+    if _DEFAULTS_BUILT:
+        return
+    _DEFAULTS_BUILT = True
+    R_sh = int(np.prod(_SHARDED_GRID))
+    R_vr = int(np.prod(_VRANK_GRID))
+    for topology, R in (("sharded", R_sh), ("vranks", R_vr)):
+        for engine in ("planar", "rowmajor", "sparse", "neighbor"):
+            fastpath = None
+            if engine == "sparse" and topology == "sharded":
+                fastpath = "sparse_wire"
+            elif engine == "neighbor" and topology == "sharded":
+                fastpath = "neighbor_wire"
+            register_program(
+                ProgramSpec(
+                    name=f"canonical_{engine}_{topology}",
+                    build=_canonical_build(engine, topology),
+                    description=(
+                        f"GridRedistribute.engine_fn({engine!r}) on the "
+                        f"{topology} CPU mesh"
+                    ),
+                    engine=engine,
+                    topology=topology,
+                    fastpath=fastpath,
+                    capacity=_CAPACITY,
+                    mover_cap=_MOVER_CAP,
+                    tags=("canonical",),
+                )
+            )
+    register_program(
+        ProgramSpec(
+            name="migrate_sparse_vranks",
+            build=_migrate_build("sparse", "vranks"),
+            description="nbody.make_migrate_loop sparse fast path on the "
+            "8-vrank mesh",
+            engine="sparse",
+            topology="vranks",
+            fastpath="migrate",
+            resident_rows=8 * 64,
+            tags=("migrate",),
+        )
+    )
+    register_program(
+        ProgramSpec(
+            name="migrate_planar_sharded",
+            build=_migrate_build("planar", "sharded"),
+            description="nbody.make_migrate_loop planar engine on the "
+            "8-device mesh",
+            engine="planar",
+            topology="sharded",
+            tags=("migrate",),
+        )
+    )
+    register_program(
+        ProgramSpec(
+            name="resident_macro_step",
+            build=_resident_build(),
+            description="service/resident.py chunk macro-step "
+            "(lax.scan of drift -> engine_fn)",
+            engine="planar",
+            topology="vranks",
+            resident=True,
+            tags=("resident",),
+        )
+    )
+    register_program(
+        ProgramSpec(
+            name="apply_assignment_oneshot",
+            build=_canonical_build("auto", "sharded", _assignment_edges),
+            description="the one-shot redistribute apply_assignment "
+            "dispatches (assignment-aware fine-grid edges)",
+            engine="sparse",
+            topology="sharded",
+            tags=("apply_assignment",),
+        )
+    )
+
+
+def default_programs() -> Dict[str, ProgramSpec]:
+    _register_defaults()
+    return dict(PROGRAMS)
+
+
+def registry_coverage(
+    programs: Dict[str, ProgramSpec]
+) -> List[ProgFinding]:
+    """J000: the registry must be exhaustive over the dispatchable
+    engines and the service-surface programs, so a new engine that is
+    not registered fails loudly instead of shipping unanalyzed."""
+    from mpi_grid_redistribute_tpu.parallel import exchange
+
+    findings: List[ProgFinding] = []
+    engines = [e for e in exchange.ENGINES if e != "auto"]
+    for engine in engines:
+        for topology in ("sharded", "vranks"):
+            if not any(
+                p.engine == engine and p.topology == topology
+                for p in programs.values()
+            ):
+                findings.append(
+                    ProgFinding(
+                        "J000",
+                        "<registry>",
+                        f"engine {engine!r} has no registered program on "
+                        f"the {topology} topology — register it in "
+                        "analysis/progcheck.py or it ships unanalyzed",
+                    )
+                )
+    for engine in exchange.COUNT_DRIVEN_ENGINES:
+        if not any(p.engine == engine for p in programs.values()):
+            findings.append(
+                ProgFinding(
+                    "J000",
+                    "<registry>",
+                    f"count-driven engine {engine!r} (exchange."
+                    "COUNT_DRIVEN_ENGINES) has no registered program",
+                )
+            )
+    for tag in ("resident", "migrate", "apply_assignment"):
+        if not any(tag in p.tags for p in programs.values()):
+            findings.append(
+                ProgFinding(
+                    "J000",
+                    "<registry>",
+                    f"no registered program carries the {tag!r} tag",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------
+
+
+def run_progcheck(
+    programs: Optional[Dict[str, ProgramSpec]] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> Tuple[List[ProgFinding], Dict[str, dict]]:
+    """Trace every program and run the J-rules. Returns
+    ``(findings, profiles)`` — profiles are the J004 inputs; the
+    CALLER gates them against the committed baseline (so
+    ``--update-baseline`` can share one trace pass)."""
+    from mpi_grid_redistribute_tpu.analysis import rules_jaxpr
+
+    programs = default_programs() if programs is None else programs
+    wanted = set(rules) if rules else set(J_RULE_IDS)
+    findings: List[ProgFinding] = []
+    profiles: Dict[str, dict] = {}
+    for name in sorted(programs):
+        spec = programs[name]
+        closed = trace_program(spec)
+        if "J001" in wanted:
+            findings.extend(rules_jaxpr.check_j001(closed, spec))
+        if "J002" in wanted:
+            findings.extend(rules_jaxpr.check_j002(closed, spec))
+        if "J003" in wanted:
+            findings.extend(rules_jaxpr.check_j003(closed, spec))
+        if "J004" in wanted:
+            profiles[name] = rules_jaxpr.program_profile(closed)
+    if "J000" in wanted:
+        findings.extend(registry_coverage(programs))
+    findings.sort(key=lambda f: (f.rule, f.program, f.message))
+    return findings, profiles
+
+
+# ---------------------------------------------------------------------
+# CLI (exit codes mirror gridlint: 0 clean, 1 findings, 2 usage)
+# ---------------------------------------------------------------------
+
+
+def _parser() -> argparse.ArgumentParser:
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        progprofile_baseline_path,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="progcheck",
+        description="Semantic jaxpr analyzer: traces the registered "
+        "SPMD programs and checks invariants J000-J004.",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "sarif", "github"),
+        default="text",
+        help="output format",
+    )
+    p.add_argument(
+        "--rules",
+        default=None,
+        metavar="J00x[,J00y]",
+        help="comma-separated subset of rules to run",
+    )
+    p.add_argument(
+        "--programs",
+        default=None,
+        metavar="NAME[,NAME]",
+        help="comma-separated subset of registered programs",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help=f"J004 profile baseline (default: {progprofile_baseline_path()})",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: additionally fail on baseline entries for "
+        "programs that are no longer registered",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write the current profiles to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--rtol",
+        type=float,
+        default=0.0,
+        help="relative tolerance for J004 numeric drift (default 0: "
+        "the static model is deterministic, any drift is a change)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    p.add_argument(
+        "--list-programs",
+        action="store_true",
+        help="list registered programs and exit",
+    )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from mpi_grid_redistribute_tpu.analysis import rules_jaxpr, sarif
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        load_progprofile_baseline,
+        progprofile_baseline_path,
+        write_progprofile_baseline,
+    )
+
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid in J_RULE_IDS:
+            print(f"{rid}  {rules_jaxpr.RULE_DOCS[rid]}")
+        return 0
+
+    rules: Optional[List[str]] = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in J_RULE_IDS]
+        if unknown:
+            print(
+                f"progcheck: unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(J_RULE_IDS)})",
+                file=sys.stderr,
+            )
+            return 2
+
+    programs = default_programs()
+    if args.list_programs:
+        for name in sorted(programs):
+            spec = programs[name]
+            print(f"{name}  [{spec.engine}/{spec.topology}]  {spec.description}")
+        return 0
+    if args.programs:
+        wanted = [p.strip() for p in args.programs.split(",") if p.strip()]
+        unknown = [p for p in wanted if p not in programs]
+        if unknown:
+            print(
+                f"progcheck: unknown program(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(programs))})",
+                file=sys.stderr,
+            )
+            return 2
+        programs = {n: programs[n] for n in wanted}
+        # a subset run can't judge registry completeness
+        rules = [r for r in (rules or J_RULE_IDS) if r != "J000"]
+
+    findings, profiles = run_progcheck(programs, rules=rules)
+
+    baseline_path = args.baseline or progprofile_baseline_path()
+    if args.update_baseline:
+        write_progprofile_baseline(baseline_path, profiles)
+        print(
+            f"progcheck: wrote {len(profiles)} program profile(s) to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    if profiles:  # J004 requested: gate against the committed baseline
+        baseline = load_progprofile_baseline(baseline_path)
+        findings.extend(
+            rules_jaxpr.compare_profiles(
+                profiles,
+                baseline,
+                rtol=args.rtol,
+                check_stale=args.check,
+                partial=args.programs is not None,
+            )
+        )
+        findings.sort(key=lambda f: (f.rule, f.program, f.message))
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "programs": sorted(programs),
+                    "profiles": profiles,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    elif args.format == "sarif":
+        print(
+            json.dumps(
+                sarif.to_sarif(findings, "progcheck", rules_jaxpr.RULE_DOCS),
+                indent=2,
+            )
+        )
+    elif args.format == "github":
+        for line in sarif.github_annotations(findings):
+            print(line)
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"progcheck: {len(findings)} finding(s) over "
+            f"{len(programs)} program(s)"
+        )
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Shared CI output formats for gridlint and progcheck findings.
+
+SARIF 2.1.0 (the static-analysis interchange format GitHub code
+scanning ingests) plus plain ``::warning`` workflow-command lines for
+inline PR annotations without an upload step. Duck-typed over both
+finding flavors: gridlint's lexical :class:`~.core.Finding` (rule,
+path, line, col, symbol, message) and progcheck's semantic
+:class:`~.progcheck.ProgFinding` (rule, program, message, synthetic
+path/line) — anything carrying ``rule``/``path``/``line``/``message``
+renders. jax-free on purpose, like the rest of the gridlint side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemas/sarif-schema-2.1.0.json"
+)
+
+
+def _message_of(f) -> str:
+    # progcheck findings carry the program name; fold it into the text
+    # so SARIF viewers (which only show path/line) keep the context
+    program = getattr(f, "program", None)
+    if program:
+        return f"<{program}>: {f.message}"
+    symbol = getattr(f, "symbol", None)
+    if symbol:
+        return f"[{symbol}] {f.message}"
+    return f.message
+
+
+def to_sarif(
+    findings: Iterable,
+    tool_name: str,
+    rule_docs: Optional[Dict[str, str]] = None,
+) -> dict:
+    """One SARIF run over ``findings``. ``rule_docs`` (rule id ->
+    one-line description) populates the tool's rule metadata so viewers
+    show what each id means."""
+    findings = list(findings)
+    rule_ids = sorted({f.rule for f in findings})
+    if rule_docs:
+        rule_ids = sorted(set(rule_ids) | set(rule_docs))
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": (rule_docs or {}).get(rid, rid)
+            },
+        }
+        for rid in rule_ids
+    ]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in findings:
+        region = {"startLine": max(int(getattr(f, "line", 1)), 1)}
+        col = getattr(f, "col", None)
+        if col is not None:
+            region["startColumn"] = max(int(col) + 1, 1)  # SARIF is 1-based
+        results.append(
+            {
+                "ruleId": f.rule,
+                "ruleIndex": index[f.rule],
+                "level": "error",
+                "message": {"text": _message_of(f)},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": str(f.path).replace("\\", "/")
+                            },
+                            "region": region,
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": tool_name,
+                        "informationUri": (
+                            "https://github.com/mpi_grid_redistribute_tpu"
+                        ),
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def github_annotations(findings: Iterable) -> List[str]:
+    """GitHub Actions workflow-command lines: printed to stdout inside a
+    workflow they render as inline PR annotations, no SARIF upload
+    needed."""
+    lines = []
+    for f in findings:
+        loc = f"file={f.path},line={max(int(getattr(f, 'line', 1)), 1)}"
+        col = getattr(f, "col", None)
+        if col is not None:
+            loc += f",col={max(int(col) + 1, 1)}"
+        title = f.rule
+        msg = _message_of(f).replace("%", "%25").replace("\n", "%0A")
+        lines.append(f"::warning {loc},title={title}::{msg}")
+    return lines

@@ -1196,6 +1196,13 @@ _COUNT_DRIVEN_VRANK_FNS = {
     "neighbor": vrank_redistribute_neighbor_fn,
 }
 
+# Public roster of the count-driven engines, in roster order. progcheck's
+# J000 completeness rule iterates this: adding an engine here without
+# registering a traceable program in analysis/progcheck.py fails the
+# registry-coverage check, so no engine ships unanalyzed.
+COUNT_DRIVEN_ENGINES = tuple(_COUNT_DRIVEN_SHARD_FNS)
+assert COUNT_DRIVEN_ENGINES == tuple(_COUNT_DRIVEN_VRANK_FNS)
+
 
 def shard_redistribute_count_driven_sharded(
     mesh: Mesh,

@@ -287,6 +287,17 @@ def _env_of(capture: dict) -> Optional[dict]:
     return env if isinstance(env, dict) else None
 
 
+def _progprofile_of(capture: dict) -> Optional[str]:
+    """The progcheck static wire-model hash the capture was taken
+    under (bench.py embeds analysis.baseline.progprofile_hash()), or
+    None for captures that predate it."""
+    parsed = capture.get("parsed", capture)
+    if not isinstance(parsed, dict):
+        return None
+    h = parsed.get("progprofile_hash")
+    return h if isinstance(h, str) else None
+
+
 def noise_floor(
     current_spread: Optional[float],
     best_spread: Optional[float],
@@ -348,7 +359,7 @@ def classify_capture(
             {},
         )
     entries = [
-        (m, _spread_of(h), _env_of(h))
+        (m, _spread_of(h), _env_of(h), _progprofile_of(h))
         for h, m in ((h, extract_metrics(h)) for h in history)
         if m
     ]
@@ -356,12 +367,14 @@ def classify_capture(
         return False, ["REGRESSION  no usable history captures"], {}
     cur_spread = _spread_of(current)
     cur_env = _env_of(current)
+    cur_pph = _progprofile_of(current)
     ok = True
     best_env: Optional[dict] = None
+    best_pph: Optional[str] = None
     for name, direction in GUARDED_METRICS.items():
         vals = [
-            (m[name], spread, env)
-            for m, spread, env in entries
+            (m[name], spread, env, pph)
+            for m, spread, env, pph in entries
             if name in m
         ]
         if name not in cur or not vals:
@@ -369,12 +382,13 @@ def classify_capture(
             lines.append(f"skip        {name}: no {which} value")
             continue
         pick = max if direction == "higher" else min
-        best, b_spread, b_env = pick(vals, key=lambda v: v[0])
+        best, b_spread, b_env, b_pph = pick(vals, key=lambda v: v[0])
         if best == 0:
             lines.append(f"skip        {name}: zero best in history")
             continue
         if name == "value":
             best_env = b_env
+            best_pph = b_pph
         delta = (
             (best - cur[name]) / best
             if direction == "higher"
@@ -410,6 +424,17 @@ def classify_capture(
         lines.append(
             "note        best capture has no env fingerprint (predates"
             " it); deltas assume a comparable machine"
+        )
+    if (
+        cur_pph is not None
+        and best_pph is not None
+        and cur_pph != best_pph
+    ):
+        lines.append(
+            "note        static wire model changed between captures "
+            f"(progprofile hash {best_pph!r}→{cur_pph!r}); a perf "
+            "delta here may be the intentional wire/footprint change "
+            "gated by progcheck J004, not a regression"
         )
     return ok, lines, labels
 

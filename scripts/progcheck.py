@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Run progcheck, the semantic jaxpr analyzer over the REAL programs.
+
+Usage:
+    python scripts/progcheck.py [--format=json|sarif|github] [--check]
+    python scripts/progcheck.py --update-baseline
+    python scripts/progcheck.py --list-rules | --list-programs
+
+Unlike gridlint (pure-stdlib AST, never executes anything), progcheck
+TRACES the registered entry points with ``jax.make_jaxpr`` — still no
+device execution, but it needs jax importable and an 8-device virtual
+CPU mesh for the sharded programs. This wrapper forces that mesh
+exactly the way tests/conftest.py does, BEFORE jax is imported, so
+``make progcheck`` behaves identically inside and outside CI.
+
+Exit codes mirror gridlint: 0 clean, 1 findings/drift, 2 usage error.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_grid_redistribute_tpu.analysis.progcheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

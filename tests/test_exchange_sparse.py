@@ -119,42 +119,14 @@ def test_count_driven_matches_planar_bitexact(
 # ------------------------------------------------------- wire structure
 
 
-def _walk_eqns(jaxpr):
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            for j in _as_jaxprs(v):
-                yield from _walk_eqns(j)
-
-
-def _as_jaxprs(v):
-    if hasattr(v, "eqns"):
-        return [v]
-    if hasattr(v, "jaxpr"):
-        return [v.jaxpr]
-    if isinstance(v, (list, tuple)):
-        return [j for x in v for j in _as_jaxprs(x)]
-    return []
-
-
-def _prims(jaxpr):
-    return {e.primitive.name for e in _walk_eqns(jaxpr)}
-
-
-def _dispatch_conds(jaxpr, prim):
-    """Cond eqns whose branches DISAGREE about containing ``prim`` —
-    the engine-dispatch cond's signature (fast and dense branches are
-    structurally different by construction)."""
-    out = []
-    for eqn in _walk_eqns(jaxpr):
-        if eqn.primitive.name != "cond":
-            continue
-        branches = list(eqn.params["branches"])
-        flags = [prim in _prims(b.jaxpr) for b in branches]
-        if len(set(flags)) == 2:
-            out.append((branches[flags.index(False)].jaxpr,
-                        branches[flags.index(True)].jaxpr))
-    return out
+# the jaxpr walk lives in the semantic analyzer now (progcheck's public
+# API; rule J003 runs these same checks over every registered program)
+from mpi_grid_redistribute_tpu.analysis.progcheck import (  # noqa: E402
+    dispatch_conds,
+    has_primitive,
+    primitive_set,
+    walk_eqns,
+)
 
 
 def test_neighbor_schedule_is_ppermute_no_dense_all_to_all(_devices):
@@ -168,15 +140,17 @@ def test_neighbor_schedule_is_ppermute_no_dense_all_to_all(_devices):
         jnp.zeros((7, 8 * 64), jnp.float32),
         jnp.zeros((8,), jnp.int32),
     ).jaxpr
-    conds = _dispatch_conds(jaxpr, "all_to_all")
+    conds = dispatch_conds(
+        jaxpr, lambda b: has_primitive(b, "all_to_all")
+    )
     assert conds, "neighbor dispatch cond not found"
-    for fast, dense in conds:
-        fast_prims = _prims(fast)
+    for _eqn, fast, dense in conds:
+        fast_prims = primitive_set(fast)
         # the fast branch is the ppermute shift schedule — never the
         # dense pool exchange
         assert "ppermute" in fast_prims
         assert "all_to_all" not in fast_prims
-        assert "ppermute" not in _prims(dense)
+        assert "ppermute" not in primitive_set(dense)
 
 
 def test_sparse_dispatch_cond_separates_pool_widths(_devices):
@@ -195,14 +169,14 @@ def test_sparse_dispatch_cond_separates_pool_widths(_devices):
     # cap, columns per destination), so find the dispatch cond by the
     # branches' all_to_all operand widths instead
     widths = []
-    for eqn in _walk_eqns(jaxpr):
+    for eqn in walk_eqns(jaxpr):
         if eqn.primitive.name != "cond":
             continue
         per_branch = []
         for b in eqn.params["branches"]:
             w = [
                 int(np.prod(e.invars[0].aval.shape))
-                for e in _walk_eqns(b.jaxpr)
+                for e in walk_eqns(b.jaxpr)
                 if e.primitive.name == "all_to_all"
             ]
             per_branch.append(max(w) if w else 0)

@@ -650,6 +650,39 @@ def test_synthetic_2x_slowdown_is_regression():
     assert labels["value"] == "REGRESSION", (labels, lines)
 
 
+def test_progprofile_hash_drift_notes():
+    """A capture taken under a different progcheck wire-model hash than
+    the best capture gets a correlation note (the delta may be the
+    intentional J004-gated change); same hash or missing hashes stay
+    silent."""
+    caps = _bench_history()
+    metrics = regress.extract_metrics(caps[-1])
+    # synthetic best that wins the per-metric pick over all committed
+    # captures, so ITS hash is the one the note compares against
+    best = {
+        k: (v * 2 if regress.GUARDED_METRICS[k] == "higher" else v / 2)
+        for k, v in metrics.items()
+    }
+    best["progprofile_hash"] = "aaaa000011112222"
+
+    def run(cur_hash):
+        cur = dict(metrics)
+        if cur_hash is not None:
+            cur["progprofile_hash"] = cur_hash
+        _, lines, _ = classify_capture(
+            {"parsed": cur}, caps + [{"parsed": best}]
+        )
+        return [ln for ln in lines if "wire model changed" in ln]
+
+    drift = run("bbbb333344445555")
+    assert len(drift) == 1, drift
+    assert "aaaa000011112222" in drift[0]
+    assert "bbbb333344445555" in drift[0]
+    assert "J004" in drift[0]
+    assert run("aaaa000011112222") == []  # same hash: no note
+    assert run(None) == []  # current predates the embed: no note
+
+
 def test_bench_check_cli_passes_on_committed_history():
     """Satellite wiring: `make bench-check` runs the classifier and a
     WOBBLE-grade delta (the committed r04→r05 history) must exit 0."""

@@ -205,36 +205,13 @@ def test_mover_capacity_growth_recovers_fast_path(rng, _devices):
 # ------------------------------------------------- jaxpr cost contract
 
 
-def _walk_eqns(jaxpr):
-    """Every eqn in ``jaxpr`` and its nested jaxprs (pjit/scan/cond/
-    shard_map bodies alike), depth-first."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for sub in _subjaxprs(eqn):
-            yield from _walk_eqns(sub)
-
-
-def _subjaxprs(eqn):
-    for v in eqn.params.values():
-        for j in _as_jaxprs(v):
-            yield j
-
-
-def _as_jaxprs(v):
-    if hasattr(v, "eqns"):
-        return [v]
-    if hasattr(v, "jaxpr"):
-        return [v.jaxpr]
-    if isinstance(v, (tuple, list)):
-        out = []
-        for x in v:
-            out.extend(_as_jaxprs(x))
-        return out
-    return []
-
-
-def _has_sort(jaxpr):
-    return any(e.primitive.name == "sort" for e in _walk_eqns(jaxpr))
+# the jaxpr walk lives in the semantic analyzer now (progcheck's public
+# API; rule J003 runs this same check over every registered program)
+from mpi_grid_redistribute_tpu.analysis.progcheck import (  # noqa: E402
+    dispatch_conds,
+    has_primitive,
+    walk_eqns,
+)
 
 
 def test_fast_branch_jaxpr_has_no_resident_scale_ops(rng, _devices):
@@ -257,7 +234,7 @@ def test_fast_branch_jaxpr_has_no_resident_scale_ops(rng, _devices):
 
     # no host round-trips anywhere in the compiled step
     assert not any(
-        "callback" in e.primitive.name for e in _walk_eqns(jaxpr)
+        "callback" in e.primitive.name for e in walk_eqns(jaxpr)
     )
 
     # the engine-dispatch cond is the one whose branches DISAGREE about
@@ -265,20 +242,14 @@ def test_fast_branch_jaxpr_has_no_resident_scale_ops(rng, _devices):
     # all (the selection sorts live outside the cond, in the shared
     # prefix). Inner conds — two_level's flat fallback, the vacated-plan
     # guard — sort on both sides or on neither.
-    dispatch = []
-    for eqn in _walk_eqns(jaxpr):
-        if eqn.primitive.name != "cond":
-            continue
-        branches = list(eqn.params["branches"])
-        sorted_flags = [_has_sort(b.jaxpr) for b in branches]
-        if len(set(sorted_flags)) == 2:
-            fast = branches[sorted_flags.index(False)].jaxpr
-            dispatch.append((eqn, fast))
+    dispatch = dispatch_conds(
+        jaxpr, lambda b: has_primitive(b, "sort")
+    )
     assert dispatch, "engine-dispatch cond not found in jaxpr"
 
     resident_elems = pos.shape[0]  # V * n rows
-    for _, fast in dispatch:
-        for e in _walk_eqns(fast):
+    for _, fast, _dense in dispatch:
+        for e in walk_eqns(fast):
             assert e.primitive.name != "sort"
             if e.primitive.name == "gather":
                 # every gather in the fast branch reads a mover-scale
