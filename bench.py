@@ -283,8 +283,10 @@ def main() -> None:
     # resident chunked-stepping capture (bench/config10_service.py):
     # service-mode pps with lax.scan macro-steps vs the eager per-step
     # loop — guards service_pps so the chunk path keeps paying for the
-    # host syncs it removed; runs in its own subprocess so the vrank
-    # topology is measured even under the 8-device forcing above
+    # host syncs it removed, and pipeline_pps (the software-pipelined
+    # scan body at the same chunk) so the overlapped schedule keeps its
+    # edge over the sequential body; runs in its own subprocess so the
+    # vrank topology is measured even under the 8-device forcing above
     service = None
     if os.environ.get("BENCH_SERVICE", "1") != "0":
         from mpi_grid_redistribute_tpu.bench import config10_service

@@ -33,7 +33,11 @@ J003      fast-path cost contract: count-driven fast branches keep the
           fast branches are sort-free with statically bounded gathers,
           the sparse wire rides mover-cap columns (never the dense
           pool width), the neighbor wire is ppermute-only with NO
-          dense ``all_to_all``.
+          dense ``all_to_all``; the software-pipelined macro-step's
+          steady-state body bins step k+1 BEFORE landing step k's
+          exchange and lands with one fused scatter (no split
+          free-stack update, at most one payload collective per
+          iteration).
 J004      static wire/footprint drift gate: per-program collective
           byte totals (scan trip counts folded in, cond billed at the
           max-bytes branch) and peak live-buffer estimates, computed
@@ -396,6 +400,34 @@ def _resident_build():
     return build
 
 
+def _pipeline_build():
+    """Builder for the software-pipelined chunk macro-step (ISSUE 12) —
+    the exact jitted program ``ServiceDriver`` dispatches when
+    ``DriverConfig.pipeline`` is on and the two-phase exchange surface
+    arms (vrank topology, planar payload, non-ragged capacities)."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from mpi_grid_redistribute_tpu.service import pipeline
+
+        rd = _mk_rd("auto", "vranks")
+        R = rd.nranks
+        pos = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
+        vel = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
+        ids = jnp.zeros((R * _N_LOCAL,), jnp.int32)
+        count = jnp.full((R,), _N_LOCAL, jnp.int32)
+        macro, _cap, _out_cap = pipeline.make_pipelined_chunk_fn(
+            rd, 0.05, 4, pos, vel, ids
+        )
+        assert getattr(
+            macro.__wrapped__, "_progcheck_pipeline", False
+        ), "make_pipelined_chunk_fn degraded to the sequential body"
+        return macro, (pos, vel, ids, count)
+
+    return build
+
+
 _DEFAULTS_BUILT = False
 
 
@@ -470,6 +502,20 @@ def _register_defaults() -> None:
     )
     register_program(
         ProgramSpec(
+            name="pipelined_macro_step",
+            build=_pipeline_build(),
+            description="service/pipeline.py software-pipelined chunk "
+            "macro-step (step k+1 binning before step k's landing, "
+            "free-stack update fused into the landing scatter)",
+            engine="planar",
+            topology="vranks",
+            resident=True,
+            fastpath="pipeline",
+            tags=("resident", "pipeline"),
+        )
+    )
+    register_program(
+        ProgramSpec(
             name="apply_assignment_oneshot",
             build=_canonical_build("auto", "sharded", _assignment_edges),
             description="the one-shot redistribute apply_assignment "
@@ -521,7 +567,7 @@ def registry_coverage(
                     "COUNT_DRIVEN_ENGINES) has no registered program",
                 )
             )
-    for tag in ("resident", "migrate", "apply_assignment"):
+    for tag in ("resident", "pipeline", "migrate", "apply_assignment"):
         if not any(tag in p.tags for p in programs.values()):
             findings.append(
                 ProgFinding(

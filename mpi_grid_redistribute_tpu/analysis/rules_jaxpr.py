@@ -51,7 +51,11 @@ RULE_DOCS = {
     "anywhere in a resident-marked program",
     "J003": "fast-path cost contract: dispatch cond present; migrate fast "
     "branches sort-free with mover-bounded gathers; sparse wire at "
-    "mover-cap columns; neighbor wire ppermute-only, no dense all_to_all",
+    "mover-cap columns; neighbor wire ppermute-only, no dense all_to_all; "
+    "pipelined steady-state body bins step k+1 before landing step k, with "
+    "exactly one landing scatter (free-stack update fused, no "
+    "dynamic_update_slice) and at most one payload collective per "
+    "iteration",
     "J004": "static wire/footprint drift: per-program collective bytes and "
     "peak live-buffer estimates must match the committed "
     "progprofile_baseline.json",
@@ -443,10 +447,93 @@ def _check_neighbor_wire(closed, spec) -> List[ProgFinding]:
     return out
 
 
+# Collectives that move particle payload (vs scalar-reduction guards):
+# the pipelined contract allows at most ONE of these per steady-state
+# iteration — a second one means the two-phase split re-acquired a
+# separate completion exchange.
+_PAYLOAD_COLLECTIVES = frozenset(
+    {"ppermute", "pshuffle", "all_to_all", "all_gather",
+     "all_gather_invariant", "psum_scatter", "reduce_scatter"}
+)
+
+
+def floor_before_scatter(jaxpr) -> bool:
+    """Does this (sub)jaxpr bin (``floor`` — the cell quantization in
+    ``binning.cell_of_position_planar``) before its first landing
+    ``scatter``, in depth-first trace order? The pipelined steady-state
+    branch does (step k+1's binning is issued against pre-landing rows);
+    the sequential branch lands first and bins after. Shared by the
+    J003 pipeline checker and the test suite's jaxpr-ordering assert."""
+    for e in walk_eqns(jaxpr):
+        if e.primitive.name == "floor":
+            return True
+        if e.primitive.name == "scatter":
+            return False
+    return False
+
+
+def _check_pipeline(closed, spec) -> List[ProgFinding]:
+    conds = dispatch_conds(closed, floor_before_scatter)
+    if not conds:
+        return [
+            ProgFinding(
+                "J003",
+                spec.name,
+                "pipelined dispatch cond lost: no cond separates an "
+                "overlapped branch (step k+1 binning issued before step "
+                "k's landing scatter) from the sequential land-then-bin "
+                "body",
+            )
+        ]
+    out: List[ProgFinding] = []
+    for _eqn, seq, pipe in conds:
+        for label, b in (("sequential", seq), ("pipelined", pipe)):
+            n_scatter = sum(
+                1 for e in walk_eqns(b) if e.primitive.name == "scatter"
+            )
+            if n_scatter != 1:
+                out.append(
+                    ProgFinding(
+                        "J003",
+                        spec.name,
+                        f"{label} branch lands with {n_scatter} scatters "
+                        "(contract: exactly one — the free-stack update "
+                        "must stay fused into the landing scatter)",
+                    )
+                )
+            if has_primitive(b, "dynamic_update_slice"):
+                out.append(
+                    ProgFinding(
+                        "J003",
+                        spec.name,
+                        f"{label} branch contains dynamic_update_slice: "
+                        "the free-stack update split back out of the "
+                        "fused landing",
+                    )
+                )
+            n_coll = sum(
+                1
+                for e in walk_eqns(b)
+                if e.primitive.name in _PAYLOAD_COLLECTIVES
+            )
+            if n_coll > 1:
+                out.append(
+                    ProgFinding(
+                        "J003",
+                        spec.name,
+                        f"{label} branch issues {n_coll} payload "
+                        "collectives per steady-state iteration "
+                        "(contract: at most one exchange per step)",
+                    )
+                )
+    return out
+
+
 _FASTPATH_CHECKS = {
     "migrate": _check_migrate,
     "sparse_wire": _check_sparse_wire,
     "neighbor_wire": _check_neighbor_wire,
+    "pipeline": _check_pipeline,
 }
 
 

@@ -35,6 +35,20 @@ chunk-vs-eager final-particle-set bit-identity audit
 (:func:`~..service.elastic.particle_set`) with a chunk length that
 does NOT divide the horizon, so boundary splitting is exercised.
 
+The third leg (ISSUE 12) times the same head chunk with
+``DriverConfig.pipeline`` on — the software-pipelined scan body from
+:mod:`~..service.pipeline`, which issues step k+1's binning before
+consuming step k's exchanged rows and lands arrivals with the
+free-stack update fused into one scatter. ``pipeline_pps`` is guarded
+HIGHER by ``bench-check`` (auto-armed) and ``pipeline_speedup``
+(pipelined / sequential, same chunk) is gated against
+``SERVICE_PIPELINE_MIN`` (default 1.1). The floor is deliberately
+modest: on one CPU device XLA serializes what a chip overlaps, so the
+CPU win comes from the shorter fused landing critical path, not from
+true compute/communication overlap — the wire-level overlap claim is
+the next chip session's to measure. The identity audit includes the
+pipelined leg.
+
 Env overrides: ``BENCH_SERVICE_ROWS`` (host rows, default 4096),
 ``BENCH_SERVICE_GRID``, ``BENCH_SERVICE_ENGINE``, ``BENCH_SERVICE_K``
 (min-of-k samples), ``BENCH_SERVICE_SEG`` (steps per timed segment,
@@ -75,7 +89,7 @@ def _knobs() -> dict:
     }
 
 
-def _make_driver(kn, chunk: int, steps: int):
+def _make_driver(kn, chunk: int, steps: int, pipeline: bool = False):
     from mpi_grid_redistribute_tpu.service import DriverConfig, ServiceDriver
 
     cfg = DriverConfig(
@@ -86,6 +100,7 @@ def _make_driver(kn, chunk: int, steps: int):
         backend="jax",
         engine=kn["engine"],
         chunk=chunk,
+        pipeline=pipeline,
         snapshot_every=0,
         health_every=0,
         watchdog_s=0.0,
@@ -93,7 +108,7 @@ def _make_driver(kn, chunk: int, steps: int):
     return ServiceDriver(cfg)
 
 
-def _measure_pps(kn, chunk: int) -> dict:
+def _measure_pps(kn, chunk: int, pipeline: bool = False) -> dict:
     """min-of-k segment timing of the full driver loop at one chunk."""
     from mpi_grid_redistribute_tpu.telemetry import regress
 
@@ -105,7 +120,7 @@ def _measure_pps(kn, chunk: int) -> dict:
             "to the steady-state sample)"
         )
     warm = max(8, 2 * chunk)
-    drv = _make_driver(kn, chunk, warm + k * seg)
+    drv = _make_driver(kn, chunk, warm + k * seg, pipeline=pipeline)
     drv.init_state()
     drv.run(max_steps=warm)  # compile + caches
 
@@ -127,19 +142,20 @@ def _measure_pps(kn, chunk: int) -> dict:
 
 
 def _bit_identity(kn) -> bool:
-    """Final particle SET, eager vs a non-divisor chunk (splits at the
-    horizon), over a short fixed trajectory."""
+    """Final particle SET across three legs — eager, a non-divisor chunk
+    (splits at the horizon), and the same chunk with the pipelined body
+    (ISSUE 12) — over a short fixed trajectory."""
     from mpi_grid_redistribute_tpu.service import elastic as elastic_lib
 
     steps = 24
     states = []
-    for chunk in (1, 7):
-        drv = _make_driver(kn, chunk, steps)
+    for chunk, pipeline in ((1, False), (7, False), (7, True)):
+        drv = _make_driver(kn, chunk, steps, pipeline=pipeline)
         drv.init_state()
         drv.run()
         states.append(elastic_lib.particle_set(*drv.state))
         drv.close()
-    return states[0] == states[1]
+    return all(s == states[0] for s in states[1:])
 
 
 def _child_main() -> int:
@@ -153,6 +169,10 @@ def _child_main() -> int:
     by_chunk = {c: _measure_pps(kn, c) for c in kn["chunks"]}
     head_chunk = max(kn["chunks"])
     head = by_chunk[head_chunk]
+    # software-pipelined leg (ISSUE 12): same head chunk, same driver,
+    # only cfg.pipeline differs — so pipeline_speedup is the price of
+    # the sequential land->drift->bin dependency chain, nothing else
+    pipe = _measure_pps(kn, head_chunk, pipeline=True)
     out = {
         "metric": "service_pps",
         "value": round(head["pps"], 2),
@@ -177,6 +197,10 @@ def _child_main() -> int:
             str(c): round(r["pps"] / eager["pps"], 3)
             for c, r in by_chunk.items()
         },
+        "pipeline_pps": round(pipe["pps"], 2),
+        "pipeline_ms_per_step": round(pipe["ms_per_step"], 3),
+        "pipeline_timing_spread": round(pipe["spread"], 4),
+        "pipeline_speedup": round(pipe["pps"] / head["pps"], 3),
         "bit_identical": _bit_identity(kn),
     }
     print(json.dumps(out), flush=True)
@@ -220,18 +244,28 @@ def run() -> dict:
         f"ms/step) -> {out['speedup_vs_eager']:.2f}x on "
         f"{out['rows']} rows / {len(out['grid'])}-axis grid "
         f"{out['grid']} ({out['n_devices']} device(s)), "
-        f"bit_identical={out['bit_identical']}"
+        f"bit_identical={out['bit_identical']}; pipelined "
+        f"{out['pipeline_pps']:.3e} pps -> {out['pipeline_speedup']:.2f}x "
+        f"over sequential chunk={out['chunk']}"
     )
     return out
 
 
-def _service_gate(out: dict, min_speedup: float = 1.5) -> list:
+def _service_gate(
+    out: dict, min_speedup: float = 1.5, min_pipeline: float = 1.1
+) -> list:
     """The `make service-bench` verdict: hard failures as reasons."""
     failures = []
     if out["speedup_vs_eager"] < min_speedup:
         failures.append(
             f"chunk={out['chunk']} speedup {out['speedup_vs_eager']:.2f}x "
             f"below the {min_speedup:.2f}x floor"
+        )
+    if out.get("pipeline_speedup", 0.0) < min_pipeline:
+        failures.append(
+            f"pipelined chunk={out['chunk']} speedup "
+            f"{out.get('pipeline_speedup', 0.0):.2f}x over the sequential "
+            f"chunk body is below the {min_pipeline:.2f}x floor"
         )
     if not out["bit_identical"]:
         failures.append(
@@ -262,19 +296,25 @@ def main(argv=None) -> int:
         "--min-speedup", type=float,
         default=float(os.environ.get("SERVICE_SPEEDUP_MIN", 1.5)),
     )
+    p.add_argument(
+        "--min-pipeline", type=float,
+        default=float(os.environ.get("SERVICE_PIPELINE_MIN", 1.1)),
+    )
     args = p.parse_args(argv)
     out = run()
     common.emit(out)
     if not args.gate:
         return 0
-    failures = _service_gate(out, args.min_speedup)
+    failures = _service_gate(out, args.min_speedup, args.min_pipeline)
     if failures:
         for f in failures:
             common.log(f"service-bench FAIL: {f}")
         return 1
     common.log(
         f"service-bench OK: {out['speedup_vs_eager']:.2f}x >= "
-        f"{args.min_speedup:.2f}x, bit-identical"
+        f"{args.min_speedup:.2f}x, pipelined "
+        f"{out['pipeline_speedup']:.2f}x >= {args.min_pipeline:.2f}x, "
+        "bit-identical"
     )
     return 0
 

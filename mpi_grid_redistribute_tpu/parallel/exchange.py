@@ -147,7 +147,12 @@ class RedistributeStats(NamedTuple):
     sparse/neighbor engines; it defaults to ``None`` (an EMPTY pytree
     node — zero leaves) so the dense engines' 5-leaf stats trees, their
     shard_map out_specs, and every consumer that never looks at it are
-    untouched."""
+    untouched.
+
+    ``pipeline`` ([R] int32, 1 where the step ran the software-pipelined
+    steady-state branch — ISSUE 12) is only emitted by the pipelined
+    resident engine and defaults to ``None`` the same way, so every
+    existing 5/6-leaf stats tree is untouched."""
 
     send_counts: jax.Array
     recv_counts: jax.Array
@@ -155,6 +160,7 @@ class RedistributeStats(NamedTuple):
     dropped_recv: jax.Array
     needed_capacity: jax.Array
     fallback: jax.Array = None
+    pipeline: jax.Array = None
 
 
 def shard_redistribute_fn(
@@ -1356,3 +1362,132 @@ def build_redistribute(
     )
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return jax.jit(sharded)
+
+
+# ---------------------------------------------------------------------------
+# Two-phase (start/finish) exchange surface — the software-pipelined
+# resident engine's dispatch point (ISSUE 12).
+# ---------------------------------------------------------------------------
+
+
+class TwoPhaseExchange(NamedTuple):
+    """Resolution record for the two-phase exchange surface (ISSUE 12).
+
+    ``armed`` is the STATIC (build-time) verdict: True means the
+    pipelined schedule is feasible and ``bundle`` carries the engine
+    implementation (a :class:`..migrate.VrankTwoPhase` for the
+    single-device vranks mesh, or any object with ``issue``/``complete``
+    attributes such as the split :func:`..migrate.shard_migrate_fused_fn`);
+    False means the caller must build the sequential body instead
+    (``bundle`` is None) and ``reason`` says why. The decision is
+    journaled as an ``engine_resolved`` event, same shape as
+    :func:`resolve_engine`'s, so silent degradation is observable."""
+
+    engine: str
+    armed: bool
+    reason: str
+    bundle: object = None
+
+
+def resolve_two_phase(
+    engine: str,
+    *,
+    chunk: int,
+    planar_ok: bool = True,
+    ragged: bool = False,
+    vranks: bool = False,
+    n_devices: int = 1,
+    build=None,
+    recorder=None,
+) -> TwoPhaseExchange:
+    """Resolve whether the software-pipelined two-phase schedule may arm
+    (ISSUE 12) — the ONE dispatch rule shared by
+    :func:`..service.pipeline.make_pipelined_chunk_fn` and any future
+    pipelined caller, mirroring :func:`resolve_engine`'s role for the
+    one-shot engines.
+
+    The pipelined steady state needs (a) at least two scan iterations so
+    an exchange can sit in flight across an iteration boundary
+    (``chunk >= 2``), (b) a planar-eligible payload (32-bit fields that
+    ride bitcast, ``planar_ok``), (c) a rectangular receive side
+    (``not ragged`` — out_capacity == n_local, so landed rows never
+    re-compact mid-chunk), and (d) a topology whose exchange completes
+    on one device (single-device vranks — cross-device two-phase needs
+    an async collective surface this engine does not have yet). Any
+    miss degrades to the sequential body at BUILD time; the runtime
+    ``lax.cond`` inside the pipelined scan handles only the dynamic
+    (backlog) case.
+
+    ``build`` is a zero-arg callable constructing the engine bundle
+    (deferred so degraded resolutions never trace it); ``recorder``
+    journals the decision as ``engine_resolved`` with
+    ``requested=engine``, ``resolved`` in {"pipeline", "sequential"}
+    and one of the five "pipeline: ..." reason strings
+    (telemetry/SCHEMA.md).
+    """
+    if engine not in ENGINES:
+        raise ValueError(
+            f"engine must be one of {ENGINES}, got {engine!r}"
+        )
+    if chunk < 2:
+        armed, reason = False, "pipeline: chunk < 2 — sequential body"
+    elif not planar_ok:
+        armed, reason = (
+            False, "pipeline: payload not planar-eligible — sequential body"
+        )
+    elif ragged:
+        armed, reason = (
+            False, "pipeline: ragged receive capacity — sequential body"
+        )
+    elif not (vranks or n_devices == 1):
+        armed, reason = (
+            False, "pipeline: multi-device topology — sequential body"
+        )
+    else:
+        armed, reason = True, "pipeline: armed (vranks planar two-phase)"
+    if recorder is not None:
+        recorder.record(
+            "engine_resolved",
+            requested=engine,
+            resolved="pipeline" if armed else "sequential",
+            reason=reason,
+            canonical=False,
+        )
+    bundle = build() if (armed and build is not None) else None
+    return TwoPhaseExchange(engine, armed, reason, bundle)
+
+
+def _two_phase_impl(handle):
+    impl = handle.bundle if isinstance(handle, TwoPhaseExchange) else handle
+    if impl is None:
+        raise TypeError(
+            "two-phase exchange is not armed (degraded resolution: "
+            f"{getattr(handle, 'reason', 'no bundle')!r}) — build the "
+            "sequential body instead"
+        )
+    return impl
+
+
+def start_exchange(handle, *args):
+    """Phase 1 of the two-phase exchange: issue the routing plan (and,
+    for engines with a real wire, put the payload in flight). Dispatches
+    through a :class:`TwoPhaseExchange` handle — or directly through any
+    engine exposing ``issue`` (the split
+    :func:`..migrate.shard_migrate_fused_fn` and
+    :class:`..migrate.VrankTwoPhase` both do). Reads nothing the
+    landing mutates, so a pipelined caller may issue step k+1 while
+    step k is still unconsumed."""
+    impl = _two_phase_impl(handle)
+    return impl.issue(*args)
+
+
+def finish_exchange(handle, *args):
+    """Phase 2 of the two-phase exchange: consume an in-flight plan and
+    land the exchanged rows (free-stack update fused into the landing
+    kernel). Dispatches to the engine's ``complete`` (flat migrate
+    engine) or ``land`` (vranks planar two-phase) half."""
+    impl = _two_phase_impl(handle)
+    finish = getattr(impl, "complete", None)
+    if finish is None:
+        finish = impl.land
+    return finish(*args)

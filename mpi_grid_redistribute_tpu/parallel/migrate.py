@@ -237,6 +237,24 @@ class MigrateStats(NamedTuple):
     fast_path: jax.Array = None  # [V] 1/0 sparse-branch taken; None = n/a
 
 
+class InflightExchange(NamedTuple):
+    """Everything the ISSUE half of a split migrate step hands the
+    COMPLETE half (ISSUE 12 two-phase surface): the exchanged arrival
+    pool plus the granted-count tables and the sender's vacated-slot
+    plan. Carrying it across a scan iteration is what lets a
+    software-pipelined macro-step overlap the exchange with the next
+    step's drift/binning before the landing consumes it.
+
+    ``recv`` is the planar ``[K, n_src * C]`` arrival pool (post-wire);
+    ``backlog`` counts granted-short rows that stayed resident."""
+
+    recv: jax.Array
+    recv_counts: jax.Array
+    send_counts: jax.Array
+    gather_idx: jax.Array
+    backlog: jax.Array
+
+
 class MigrateState(NamedTuple):
     """Scan-carry state for the fused migration loop.
 
@@ -438,6 +456,11 @@ def _stack_push_pop(free_stack, n_free, n_pop, n_push, vacated, n_in):
 
     ``vacated`` has static length P; the window is ``min(P, n)`` entries
     whose start is clamped in bounds. Returns ``(free_stack, n_free)``.
+
+    Used by the vmapped vranks landing only: :func:`_land_arrivals` (and
+    the two-phase landing it feeds, ISSUE 12) now inlines the equivalent
+    full-width where-blend into the landing kernel itself, sharing the
+    plan quantities the scatter already materialized.
     """
     n = free_stack.shape[0]
     P = vacated.shape[0]
@@ -532,12 +555,26 @@ def _land_arrivals(
     # THE scatter: payload + alive flag + hole markers in one pass.
     fused = _land_scatter(fused, target, cols, scatter_impl)
 
-    # Free-stack update: net excess departures (n_sent - n_in when
-    # positive) were written as holes at vacated[n_in : n_sent]: push them.
+    # Free-stack update FUSED into the landing kernel (ISSUE 12): net
+    # excess departures (n_sent - n_in when positive) were written as
+    # holes at vacated[n_in : n_sent]; push them with a full-width
+    # where-blend over the SAME plan quantities the scatter just
+    # consumed (k-window arithmetic, ``vacated``) instead of the old
+    # separate :func:`_stack_push_pop` windowed read-modify-write pass —
+    # one fewer dynamic_slice/dynamic_update_slice pair per step, and
+    # XLA fuses the blend into the landing fusion. ``n_pop`` and
+    # ``n_push`` are mutually exclusive (one is the positive part of
+    # ``n_in - n_sent``, the other of its negation), so the push base
+    # ``n_free - n_pop`` equals ``n_free`` whenever pushes exist —
+    # bit-identical stack contents to the windowed update.
     n_push = jnp.maximum(n_sent - n_in, 0)
-    free_stack, new_n_free = _stack_push_pop(
-        free_stack, n_free, n_pop, n_push, vacated, n_in
+    base = n_free - n_pop
+    s_idx = jnp.arange(n, dtype=jnp.int32)
+    push_vals = vacated[jnp.clip(n_in + s_idx - base, 0, P - 1)]
+    free_stack = jnp.where(
+        (s_idx >= base) & (s_idx < base + n_push), push_vals, free_stack
     )
+    new_n_free = base + n_push
     return fused, free_stack, new_n_free, n_in, dropped_recv
 
 
@@ -579,7 +616,11 @@ def shard_migrate_fused_fn(
         )
     impl = _resolve_scatter_impl(scatter_impl)
 
-    def fn(state: MigrateState):
+    def issue(state: MigrateState) -> InflightExchange:
+        """ISSUE half (ISSUE 12): bin -> grant -> pack -> wire. Leaves
+        the resident state untouched (sent rows stay in place until the
+        landing vacates them), so a pipelined caller can keep computing
+        on ``state`` while the returned exchange is in flight."""
         fused, free_stack, n_free = state
         K = fused.shape[0]
         me = lax.axis_index(axes).astype(jnp.int32)
@@ -674,25 +715,41 @@ def shard_migrate_fused_fn(
                 split_axis=0, concat_axis=0, tiled=True,
             )  # [R, K, C]
             recv = recv.transpose(1, 0, 2).reshape(K, R * C)
+        return InflightExchange(
+            recv, recv_counts, send_counts, gather_idx, backlog
+        )
 
+    def complete(state: MigrateState, inflight: InflightExchange):
+        """COMPLETE half (ISSUE 12): land the exchanged rows (free-stack
+        update fused into the landing kernel) and assemble stats."""
+        fused, free_stack, n_free = state
         with traced_span("mig:unpack"):
             fused, free_stack, n_free, n_in, dropped_recv = _land_arrivals(
-                fused, free_stack, n_free, recv, recv_counts, send_counts,
-                gather_idx, C, impl,
+                fused, free_stack, n_free, inflight.recv,
+                inflight.recv_counts, inflight.send_counts,
+                inflight.gather_idx, C, impl,
             )
         population = jnp.sum((fused[-1, :] > 0).astype(jnp.int32))
         stats = MigrateStats(
-            sent=jnp.sum(send_counts).astype(jnp.int32)[None],
+            sent=jnp.sum(inflight.send_counts).astype(jnp.int32)[None],
             received=n_in[None],
             population=population[None],
-            backlog=backlog[None],
+            backlog=inflight.backlog[None],
             dropped_recv=dropped_recv[None],
             # granted sends, already computed for the pack phase: my row
             # of the global [R, R] flow matrix (shard axis 0 stacks rows)
-            flow=send_counts[None],
+            flow=inflight.send_counts[None],
         )
         return MigrateState(fused, free_stack, n_free), stats
 
+    def fn(state: MigrateState):
+        return complete(state, issue(state))
+
+    # the split halves ARE the engine: fn is their recomposition (pure
+    # code motion — identical eqn order, so J004 profiles are untouched),
+    # and exchange.resolve_two_phase routes pipelined callers here
+    fn.issue = issue
+    fn.complete = complete
     return fn
 
 
@@ -705,6 +762,173 @@ def _greedy_alloc(desired: jax.Array, cap: jax.Array) -> jax.Array:
     prev = cum - desired
     capb = cap[None, :]
     return jnp.clip(jnp.minimum(cum, capb) - jnp.minimum(prev, capb), 0)
+
+
+class VrankPlan(NamedTuple):
+    """One step's routing decision from :class:`VrankTwoPhase.issue`
+    (ISSUE 12): the sender-side vacated-slot plan, the receiver-side
+    arrival gather plan (GLOBAL column ids into the ``[K, V * n]``
+    matrix), the granted/desired count tables and the per-source
+    ``backlog`` (rows the flow control declined this step). Plans are
+    ``n``-wide — wide enough that the flow-control grant is the ONLY
+    clip, so ``backlog == 0`` means every leaver was granted."""
+
+    vacated: jax.Array  # [V, n] local vacated slot ids (first n_sent)
+    n_sent: jax.Array  # [V]
+    arr_plan: jax.Array  # [V, n] global arrival source columns
+    n_in: jax.Array  # [V]
+    allowed: jax.Array  # [V, V] granted sends [src, dst]
+    desired: jax.Array  # [V, V] pre-grant leaver counts [src, dst]
+    backlog: jax.Array  # [V] per-source granted-short rows
+
+
+class VrankTwoPhase(NamedTuple):
+    """The two-phase (start/finish) exchange surface for a SINGLE-DEVICE
+    vrank mesh (ISSUE 12), built by :func:`vrank_exchange_two_phase_fn`
+    and routed to callers via ``exchange.resolve_two_phase``.
+
+    ``bin_key`` computes the per-column destination key; ``issue`` turns
+    a key into a :class:`VrankPlan` (routing sort + receiver-granted
+    flow control + cycle rescue + both gather plans); ``land`` lands a
+    gathered arrival payload in ONE scatter with the free-stack update
+    fused in. The split is what a software-pipelined macro-step needs:
+    the plan + payload gather for step k can sit in flight while step
+    k+1's drift/binning is issued, and the landing consumes them a full
+    iteration later."""
+
+    bin_key: object
+    issue: object
+    land: object
+    vranks: int
+    n_local: int
+
+
+def vrank_exchange_two_phase_fn(
+    domain: Domain, vgrid: ProcessGrid, n_local: int, ndim: int = None,
+    cycle_rescue: bool = True, scatter_impl=None,
+) -> VrankTwoPhase:
+    """Build the Dev==1 planar vranks two-phase exchange (ISSUE 12).
+
+    All ``V = vgrid.nranks`` ranks live on one device as lane-axis
+    blocks of a planar ``[K, V * n]`` matrix, so the "wire" is a pair of
+    in-HBM gathers and the issue/complete halves can be separated by a
+    whole scan iteration without any collective in flight. Semantics
+    mirror :func:`shard_migrate_fused_fn` (receiver-granted flow
+    control, cycle rescue, single landing scatter) with plan width
+    ``n = n_local`` per vrank: nothing is ever clipped by the plan, so
+    ``backlog`` is exactly the flow-control residue.
+
+    The landing scatter preserves the uniqueness invariant of
+    :func:`_land_scatter`: per vrank, targets are vacated slots (disjoint
+    prefixes of a sort permutation) plus popped stack entries (distinct
+    hole ids), globalized onto disjoint column blocks across vranks.
+
+    Note the per-row ``take_along_axis`` gathers here are [V, n]-scale;
+    fine on CPU meshes (where this engine is currently gated), but a
+    chip session should linearize them like :func:`_plan_rows_batched`
+    before lifting the CPU-only restriction.
+    """
+    V = vgrid.nranks
+    n = int(n_local)
+    D = domain.ndim if ndim is None else ndim
+    rescue = cycle_rescue and V <= 128
+    impl = _resolve_scatter_impl(scatter_impl)
+
+    def bin_key(fused: jax.Array) -> jax.Array:
+        """[K, V*n] planar matrix -> [V, n] destination-vrank key, with
+        the sentinel ``V`` on stayers and holes (the only values
+        :func:`..ops.binning.sorted_dest_counts_batched` counts are
+        genuine leavers). Routing is the SAME
+        :func:`..ops.binning.rank_of_position_planar` the canonical
+        planar engines call, so a pipelined step homes every particle on
+        exactly the vrank the sequential engine would."""
+        m = fused.shape[1]
+        alive = fused[-1, :] > 0
+        me = (jnp.arange(m, dtype=jnp.int32) // n).astype(jnp.int32)
+        pos_f = lax.bitcast_convert_type(fused[:D, :], jnp.float32)
+        dest = binning.rank_of_position_planar(pos_f, domain, vgrid)
+        key = jnp.where(alive & (dest != me), dest, V).astype(jnp.int32)
+        return key.reshape(V, n)
+
+    def issue(key: jax.Array, n_free: jax.Array) -> VrankPlan:
+        """Routing sort + receiver-granted flow control + gather plans.
+        Reads only the key and the free-slot counts — never the payload
+        — so a pipelined caller can issue step k+1 against a matrix
+        whose step-k arrivals are still in flight."""
+        order, counts, bounds = binning.sorted_dest_counts_batched(key, V)
+        desired = counts.astype(jnp.int32)  # [V, V] [src, dst]
+        swap = jnp.minimum(desired, desired.T)
+        resid = _greedy_alloc(
+            desired - swap, jnp.maximum(n_free, 0)
+        ).astype(jnp.int32)
+        allowed = swap + resid
+        if rescue:
+            pending = desired - allowed
+            F = _cycle_rescue(pending, jnp.sum(allowed, axis=1) == 0)
+            allowed = allowed + F
+        backlog = jnp.sum(desired - allowed, axis=1).astype(jnp.int32)
+        vacated, n_sent = _plan_rows_batched(
+            bounds[:, :-1], allowed, order, n
+        )
+        arr_plan, n_in = _plan_rows_batched(
+            bounds[:, :-1].T, allowed.T, order, n,
+            seg_rows=jnp.arange(V, dtype=jnp.int32),
+        )
+        return VrankPlan(
+            vacated, n_sent.astype(jnp.int32), arr_plan,
+            n_in.astype(jnp.int32), allowed, desired, backlog,
+        )
+
+    def land(fused, free_stack, n_free, arr, vacated, n_sent, n_in):
+        """Land a gathered ``[K, V, n]`` arrival payload: ONE scatter
+        writes payload + alive + hole markers for every vrank, and the
+        free-stack update rides the same plan quantities as a fused
+        full-width blend (no second pass over the landing rows).
+        Row-count agnostic: callers may land an augmented matrix (extra
+        key row) through the same kernel. Returns
+        ``(fused, free_stack, n_free, dropped [V])``."""
+        Kx = fused.shape[0]
+        k_idx = jnp.arange(n, dtype=jnp.int32)[None, :]  # [1, n]
+        ns = n_sent[:, None]
+        ni = n_in[:, None]
+        n_pop = jnp.clip(n_in - n_sent, 0, n_free)  # [V]
+        dropped = jnp.maximum(n_in - n_sent - n_free, 0).astype(jnp.int32)
+        pop_idx = jnp.clip(
+            n_free[:, None] - 1 - (k_idx - ns), 0, n - 1
+        )
+        popped = jnp.take_along_axis(free_stack, pop_idx, axis=1)
+        target = jnp.where(
+            k_idx < jnp.minimum(ni, ns),
+            vacated,
+            jnp.where(
+                (k_idx >= ns) & (k_idx < ns + n_pop[:, None]),
+                popped,
+                jnp.where((k_idx >= ni) & (k_idx < ns), vacated, n),
+            ),
+        )  # [V, n] local targets, sentinel n
+        v_off = jnp.arange(V, dtype=jnp.int32)[:, None]
+        gtarget = jnp.where(target >= n, V * n, v_off * n + target)
+        cols = jnp.where((k_idx < ni)[None, :, :], arr, 0)
+        fused = _land_scatter(
+            fused, gtarget.reshape(-1), cols.reshape(Kx, V * n), impl
+        )
+        # free-stack update fused into the landing (see _land_arrivals)
+        n_push = jnp.maximum(n_sent - n_in, 0)
+        base = n_free - n_pop
+        s_idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+        push_vals = jnp.take_along_axis(
+            vacated,
+            jnp.clip(ni + s_idx - base[:, None], 0, n - 1),
+            axis=1,
+        )
+        free_stack = jnp.where(
+            (s_idx >= base[:, None]) & (s_idx < (base + n_push)[:, None]),
+            push_vals,
+            free_stack,
+        )
+        return fused, free_stack, base + n_push, dropped
+
+    return VrankTwoPhase(bin_key, issue, land, V, n)
 
 
 def _plan_rows(seg_starts, seg_counts, order, length: int):

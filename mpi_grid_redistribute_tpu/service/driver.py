@@ -91,6 +91,13 @@ class DriverConfig:
     # today's eager loop; the numpy oracle backend batches the same
     # boundary bookkeeping without a device scan.
     chunk: int = 1
+    # software-pipelined macro-step (ISSUE 12): overlap each step's
+    # exchange with the next step's drift/binning inside the resident
+    # scan (service/pipeline.py). Build-time infeasible schedules
+    # (chunk < 2, non-planar payload, ragged capacities, multi-device
+    # topology) degrade to the sequential body, journaled as
+    # engine_resolved; chunk auto-split rules are unchanged.
+    pipeline: bool = False
     # elastic restore (ISSUE 8): re-shard a snapshot whose (nranks,
     # rows_per_shard) disagrees with this config onto the configured
     # grid in one canonical redistribute; off = clear ElasticRestoreError
@@ -747,18 +754,23 @@ class ServiceDriver:
     def _macro_fn(self, n: int):
         """Compiled ``n``-step macro fn (+ its capacities), cached on
         everything that changes the traced program."""
-        from mpi_grid_redistribute_tpu.service import resident
+        from mpi_grid_redistribute_tpu.service import pipeline, resident
 
         rd = self._rd
         pos, vel, ids, _ = self.state
+        pipelined = bool(self.cfg.pipeline) and n >= 2
         key = (
             n, pos.shape[0], rd.capacity, rd.out_capacity,
-            rd._mover_cap, rd.edges, self.engine,
+            rd._mover_cap, rd.edges, self.engine, pipelined,
         )
         entry = self._chunk_cache.get(key)
         if entry is None:
-            entry = resident.make_chunk_fn(rd, self.cfg.dt, n,
-                                           pos, vel, ids)
+            build = (
+                pipeline.make_pipelined_chunk_fn
+                if pipelined
+                else resident.make_chunk_fn
+            )
+            entry = build(rd, self.cfg.dt, n, pos, vel, ids)
             self._chunk_cache[key] = entry
         return entry
 
@@ -1039,6 +1051,13 @@ def main(argv=None) -> int:
              "1 = eager per-step loop)",
     )
     p.add_argument(
+        "--pipeline", action="store_true",
+        help="software-pipeline the resident macro-step: overlap each "
+             "step's exchange with the next step's binning "
+             "(service/pipeline.py; degrades to the sequential body "
+             "when the schedule is infeasible)",
+    )
+    p.add_argument(
         "--no-resume", action="store_true",
         help="ignore existing snapshots; start from the seeded state",
     )
@@ -1117,6 +1136,7 @@ def main(argv=None) -> int:
         watchdog_s=args.watchdog,
         step_sleep=args.step_sleep,
         chunk=args.chunk,
+        pipeline=args.pipeline,
         auto_reshard=not args.no_reshard,
         slo_latency_p99_s=args.slo_p99,
         rebalance=args.rebalance,

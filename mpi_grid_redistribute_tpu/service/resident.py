@@ -36,6 +36,12 @@ host sync (``np.asarray`` / ``.block_until_ready()`` / ``float()`` on
 a tracer) slipped inside it, and the jaxpr walk in
 ``tests/test_resident.py`` is the dynamic backstop asserting the
 traced program carries no host callbacks.
+
+This builder runs the steps strictly in order. Its software-pipelined
+sibling — :func:`..service.pipeline.make_pipelined_chunk_fn`, same
+signature and return contract — overlaps step k's exchange with step
+k+1's binning on eligible topologies and degrades back to THIS builder
+otherwise (``DriverConfig.pipeline`` selects between them).
 """
 
 from __future__ import annotations

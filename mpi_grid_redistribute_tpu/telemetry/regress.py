@@ -96,6 +96,13 @@ GUARDED_METRICS: Dict[str, str] = {
     # chunk interior. Auto-arms: skipped against histories that predate
     # the field (the PR 3 pattern).
     "service_pps": "higher",
+    # the software-pipelined macro-step's throughput at the same head
+    # chunk (bench.py "service" key <- config10_service, ISSUE 12):
+    # guards the overlapped scan body — a regression here means the
+    # land->drift->bin dependency chain crept back into the steady
+    # state, or the fused free-stack landing split into two scatters.
+    # Auto-arms: skipped against histories that predate the field.
+    "pipeline_pps": "higher",
 }
 
 # nested fallbacks: a metric missing at the top level of the parsed
@@ -110,6 +117,7 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "exchange_wire_bytes_per_step": ("report", "wire_bytes_per_step"),
     "rebalance_drift_ms": ("rebalance", "steady_ms_per_step"),
     "service_pps": ("service", "value"),
+    "pipeline_pps": ("service", "pipeline_pps"),
 }
 
 

@@ -144,6 +144,17 @@ def exchange_report(
         taken = int(np.count_nonzero(fp.any(axis=1)))
         out["fast_path_steps"] = taken
         out["fast_path_hit_rate"] = taken / fp.shape[0] if fp.shape[0] else None
+    # software-pipelined branch trace (ISSUE 12): `pipeline` is a
+    # [..., R] 1/0 trace on the pipelined resident engine's stats (1 =
+    # that step's exchange armed for overlapped consumption); every
+    # other engine carries None and omits the pair. Mirrors fast_path_*
+    # so operators can see how often the pipelined branch actually ran.
+    pl = getattr(stats, "pipeline", None)
+    if pl is not None:
+        pl = np.asarray(pl).reshape(-1, np.asarray(pl).shape[-1])
+        hit = int(np.count_nonzero(pl.any(axis=1)))
+        out["pipeline_steps"] = hit
+        out["pipeline_hit_rate"] = hit / pl.shape[0] if pl.shape[0] else None
     # count-driven fallback trace (ISSUE 7): `fallback` is a [..., R] 1/0
     # guard trace on sparse/neighbor canonical stats (1 = that step took
     # the dense in-graph fallback); dense engines carry None and omit

@@ -1,0 +1,169 @@
+"""Knockout attribution of the PIPELINED macro-step's iteration phases
+(ISSUE 12), through ``telemetry.phases.attribute_phases``.
+
+Unlike ``scripts/knockout_stages.py`` — which must maintain a deliberate
+truncatable COPY of the migrate step — the two-phase engine's surface
+(``migrate.vrank_exchange_two_phase_fn``: ``bin_key`` / ``issue`` /
+``land``) is already split at exactly the boundaries a truncating
+profiler needs, so this script composes the REAL kernels and cuts
+between them: nothing here can drift out of sync with the engine.
+
+The iteration is attributed in issue-first order (drift -> bin ->
+issue -> arrival gather -> fused landing). The pipelined and sequential
+orderings of ``service/pipeline.py``'s scan body run these same kernels
+(the ``lax.cond`` branches are bit-identical by construction), so the
+per-phase costs carry over to BOTH schedules on a platform with no real
+compute/communication overlap (CPU — where this engine is currently
+gated). On a chip, re-attribute with the profiler trace instead: the
+point of the pipelined schedule there is that "issue" and "landing" of
+ADJACENT steps overlap, which cumulative truncation cannot see.
+
+Usage: JAX_PLATFORMS=cpu python scripts/knockout_pipeline.py [n_local]
+       KNOCKOUT_GRID=2,2,2 (default)  KNOCKOUT_JSON=file dumps the rows
+       for scripts/trace_export.py --phases.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.ops import binning, pack
+from mpi_grid_redistribute_tpu.parallel import migrate
+from mpi_grid_redistribute_tpu.telemetry import phases as phases_lib
+
+GRID = tuple(
+    int(x) for x in os.environ.get("KNOCKOUT_GRID", "2,2,2").split(",")
+)
+FILL = 0.9
+K = 7  # 3 pos + 3 vel + alive, the service payload
+HBM_PEAK = 819e9
+
+PHASES = (
+    "1 drift + wrap",
+    "2 bin (routing key)",
+    "3 issue (sort + flow-control plans)",
+    "4 arrival gather",
+    "5 landing (fused scatter + free-stack)",
+)
+
+
+def phase_bytes(V, n):
+    """Minimum logical traffic per phase (same convention as
+    ``scripts/knockout_stages.py``: measured/roofline >> 1 flags a
+    latency/serialization bound, not a bandwidth wall)."""
+    f32 = 4
+    return {
+        PHASES[0]: (3 + 3 + 3) * V * n * f32,   # read pos+vel, write pos
+        PHASES[1]: (3 + 1 + 1) * V * n * f32,   # read pos+alive, write key
+        PHASES[2]: 4 * V * n * f32,             # sort in/out of (key, iota)
+        PHASES[3]: 2 * K * V * n * f32,         # gather in + out
+        PHASES[4]: (K + 1 + 2) * V * n * f32,   # scatter + targets + stack
+    }
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    vgrid = ProcessGrid(GRID)
+    V = vgrid.nranks
+    domain = Domain(0.0, 1.0, periodic=True)
+    tp = migrate.vrank_exchange_two_phase_fn(domain, vgrid, n)
+
+    rng = np.random.default_rng(0)
+    fused = rng.random((K, V * n), dtype=np.float32).view(np.int32)
+    fused[-1, :] = (rng.random((V * n,)) < FILL).astype(np.int32)
+    state = migrate.init_state(
+        jax.device_put(jnp.asarray(fused)), vranks=V, batched=True
+    )
+    print(f"shapes: V={V} n={n} (plan width = n)", file=sys.stderr)
+
+    def loop_builder(phase, S):
+        @jax.jit
+        def loop(fused, free_stack, n_free):
+            def dep_out(T, stack, nf, *arrs):
+                # fold a tiny dependency into the carry so nothing is
+                # DCE'd (the knockout_stages idiom)
+                d = jnp.int32(0)
+                for a in arrs:
+                    d = d + (
+                        a.ravel()[0] == jnp.asarray(7, a.dtype)
+                    ).astype(jnp.int32)
+                return T.at[0, 0].add(d.astype(T.dtype)), stack, nf
+
+            def body(carry, _):
+                T, stack, nf = carry
+                pf = lax.bitcast_convert_type(T[:3, :], jnp.float32)
+                vf = lax.bitcast_convert_type(T[3:6, :], jnp.float32)
+                p = binning.wrap_periodic_planar(
+                    pf + vf * jnp.float32(1e-4), domain
+                )
+                U = jnp.concatenate(
+                    [lax.bitcast_convert_type(p, jnp.int32), T[3:, :]],
+                    axis=0,
+                )
+                if phase == PHASES[0]:
+                    return dep_out(U, stack, nf), ()
+                key = tp.bin_key(U)
+                if phase == PHASES[1]:
+                    return dep_out(U, stack, nf, key), ()
+                plan = tp.issue(key, nf)
+                if phase == PHASES[2]:
+                    return dep_out(
+                        U, stack, nf,
+                        plan.vacated, plan.arr_plan,
+                        plan.n_sent, plan.n_in,
+                    ), ()
+                arr = pack.gather_plan_cols(U, plan.arr_plan)
+                if phase == PHASES[3]:
+                    return dep_out(U, stack, nf, arr), ()
+                T2, stack2, nf2, _ = tp.land(
+                    U, stack, nf, arr,
+                    plan.vacated, plan.n_sent, plan.n_in,
+                )
+                return (T2, stack2, nf2), ()
+
+            carry, _ = lax.scan(
+                body, (fused, free_stack, n_free), None, length=S
+            )
+            return carry[0]
+
+        return loop
+
+    for line in phases_lib.format_phase_table([]).splitlines():
+        print(line, file=sys.stderr, flush=True)
+    rows = []
+
+    def stream(row):
+        rows.append(row)
+        table = phases_lib.format_phase_table(rows)
+        print(table.splitlines()[-1], file=sys.stderr, flush=True)
+
+    phases_lib.attribute_phases(
+        loop_builder,
+        tuple(state),
+        PHASES,
+        s1=4,
+        s2=16,
+        phase_bytes=phase_bytes(V, n),
+        peak_bytes_per_sec=HBM_PEAK,
+        progress=stream,
+    )
+    out_json = os.environ.get("KNOCKOUT_JSON")
+    if out_json:
+        import json
+
+        with open(out_json, "w") as f:
+            json.dump([r._asdict() for r in rows], f, indent=1)
+        print(f"wrote {out_json} ({len(rows)} phase rows)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
