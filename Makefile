@@ -2,7 +2,7 @@ PY ?= python
 
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
 	soak soak-smoke rebalance-smoke service-bench progcheck \
-	progcheck-baseline
+	progcheck-baseline shardcheck shardcheck-baseline check
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -83,12 +83,19 @@ service-bench:
 
 # gridlint: AST-based SPMD/JIT invariant checker (G001-G009), then
 # progcheck: the semantic jaxpr analyzer (J000-J004) over the REAL
-# traced programs. Exit 0 = clean or fully baselined; 1 = new findings
-# or stale baseline entries; 2 = usage/parse error.
+# traced programs, then shardcheck: the sharding/replication abstract
+# interpreter (S001-S004). Exit 0 = clean or fully baselined; 1 = new
+# findings or stale baseline entries; 2 = usage/parse error.
 # See mpi_grid_redistribute_tpu/analysis/.
 lint:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --check
 	$(PY) scripts/progcheck.py --check
+	$(PY) scripts/shardcheck.py --check
+
+# one-shot CI umbrella: all three analyzers, SARIF runs merged into a
+# single analysis_merged.sarif for one code-scanning upload
+check:
+	$(PY) scripts/check_all.py
 
 # progcheck alone: trace every registered SPMD program on the virtual
 # 8-device CPU mesh and gate J001-J004 plus the static wire/footprint
@@ -101,6 +108,18 @@ progcheck:
 # footprint change (justify the delta in the commit message)
 progcheck-baseline:
 	$(PY) scripts/progcheck.py --update-baseline
+
+# shardcheck alone: infer per-mesh-axis vary-sets for every registered
+# program and gate S001-S003 plus the S004 per-axis ICI/DCN wire
+# attribution against progprofile_baseline.json's wire_attribution
+# section. Same trace-only machinery as progcheck.
+shardcheck:
+	$(PY) scripts/shardcheck.py --check
+
+# refresh the S004 wire-attribution baseline after an INTENTIONAL
+# re-routing of collectives across the mesh (justify the delta)
+shardcheck-baseline:
+	$(PY) scripts/shardcheck.py --update-baseline
 
 lint-json:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --format=json

@@ -51,10 +51,26 @@ across ``lax.cond`` branches (J001), no host syncs in resident-marked
 programs (J002), the fast-path cost contracts (J003), and a static
 wire/footprint profile gated against
 ``analysis/progprofile_baseline.json`` (J004). CLI:
-``python scripts/progcheck.py --check`` (``make progcheck``). progcheck
-is NOT imported here: this package root must stay importable without
-jax (gridlint and the baseline helpers run host-only), so pull it in
-explicitly via ``mpi_grid_redistribute_tpu.analysis.progcheck``.
+``python scripts/progcheck.py --check`` (``make progcheck``).
+
+The third family is **shardcheck** (``analysis/shardcheck.py`` +
+``analysis/rules_shard.py``): a forward abstract interpreter that maps
+every var of every traced program to the set of mesh axes it may vary
+over, and S-rules S001–S004 on top — replicated-out_specs consistency
+(S001), redundant collectives (S002, journal-suppressed via
+``analysis/shardcheck_baseline.json``), varying-value escapes to
+host-visible surfaces (S003), and a per-axis ICI-vs-DCN wire
+attribution drift-gated against the ``wire_attribution`` section of
+the shared profile baseline (S004). J001 consumes this pass for its
+replication proof. CLI: ``python scripts/shardcheck.py --check``
+(``make shardcheck``); ``make check`` merges all three analyzers'
+SARIF runs into one file via ``scripts/check_all.py``.
+
+progcheck and shardcheck are NOT imported here: this package root must
+stay importable without jax (gridlint and the baseline helpers run
+host-only), so pull them in explicitly via
+``mpi_grid_redistribute_tpu.analysis.progcheck`` /
+``mpi_grid_redistribute_tpu.analysis.shardcheck``.
 """
 
 from mpi_grid_redistribute_tpu.analysis.core import (

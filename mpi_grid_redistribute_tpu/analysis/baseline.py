@@ -21,10 +21,21 @@ BaselineKey = Tuple[str, str, str, str]
 
 _BASELINE_NAME = "gridlint_baseline.json"
 _PROGPROFILE_NAME = "progprofile_baseline.json"
+_SHARDCHECK_NAME = "shardcheck_baseline.json"
 
 
 def default_baseline_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)), _BASELINE_NAME)
+
+
+def shardcheck_baseline_path() -> str:
+    """The S001-S003 journal-suppression baseline (same schema and
+    matching semantics as the gridlint baseline — :func:`load_baseline`
+    / :func:`write_baseline` / :func:`split_baselined` apply verbatim;
+    shardcheck findings use the program name as the symbol)."""
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), _SHARDCHECK_NAME
+    )
 
 
 def load_baseline(path: str) -> Set[BaselineKey]:
@@ -49,10 +60,20 @@ def load_baseline(path: str) -> Set[BaselineKey]:
     return keys
 
 
+_GRIDLINT_BASELINE_COMMENT = (
+    "gridlint baseline: findings accepted at linter introduction. "
+    "Matching is line-insensitive (rule, path, symbol, message). "
+    "Remove entries as the underlying code is fixed; never add "
+    "entries to dodge a new finding — fix or inline-suppress with "
+    "a reason instead."
+)
+
+
 def write_baseline(
     path: str,
     findings: Sequence[Finding],
     justification: str = "grandfathered at baseline creation",
+    comment: Optional[str] = None,
 ) -> None:
     entries = [
         {
@@ -65,13 +86,7 @@ def write_baseline(
         for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
     ]
     payload = {
-        "comment": (
-            "gridlint baseline: findings accepted at linter introduction. "
-            "Matching is line-insensitive (rule, path, symbol, message). "
-            "Remove entries as the underlying code is fixed; never add "
-            "entries to dodge a new finding — fix or inline-suppress with "
-            "a reason instead."
-        ),
+        "comment": comment or _GRIDLINT_BASELINE_COMMENT,
         "findings": entries,
     }
     with open(path, "w", encoding="utf-8") as fh:
@@ -120,25 +135,101 @@ def load_progprofile_baseline(
     return profiles
 
 
+_PROGPROFILE_COMMENT = (
+    "progcheck J004 baseline: the static wire/footprint profile "
+    "(collective bytes, peak live-buffer estimate) of every "
+    "registered program, computed from jaxpr shapes x itemsize. "
+    "Deterministic for a fixed program: any drift is a real "
+    "cost-model change. Refresh with "
+    "`python scripts/progcheck.py --update-baseline` and justify "
+    "the delta in the commit message."
+)
+
+
+def _read_profile_doc(path: str) -> dict:
+    """The full profile-baseline document, ``{}`` when absent. Both
+    writers merge through this so progcheck's ``profiles`` section and
+    shardcheck's ``wire_attribution`` section can refresh independently
+    without clobbering each other."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            data = json.load(fh)
+        except ValueError as exc:
+            raise SystemExit(
+                f"progcheck: malformed profile baseline {path}: {exc} — "
+                "delete it and regenerate with --update-baseline"
+            )
+    if not isinstance(data, dict):
+        raise SystemExit(
+            f"progcheck: malformed profile baseline {path}: expected a "
+            "top-level JSON object — regenerate with --update-baseline"
+        )
+    return data
+
+
+def _write_profile_doc(path: str, doc: dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
 def write_progprofile_baseline(
     path: Optional[str], profiles: Dict[str, dict]
 ) -> None:
     path = path or progprofile_baseline_path()
-    payload = {
+    doc = _read_profile_doc(path)
+    doc["comment"] = _PROGPROFILE_COMMENT
+    doc["profiles"] = {k: profiles[k] for k in sorted(profiles)}
+    _write_profile_doc(path, doc)
+
+
+# -- shardcheck's S004 wire-attribution section ------------------------
+
+
+def load_wire_baseline(
+    path: Optional[str] = None,
+) -> Optional[Dict[str, dict]]:
+    """name -> wire-attribution dict from the ``wire_attribution``
+    section, or ``None`` when the file or section doesn't exist yet
+    (shardcheck then reports every program as unbaselined)."""
+    path = path or progprofile_baseline_path()
+    if not os.path.exists(path):
+        return None
+    doc = _read_profile_doc(path)
+    section = doc.get("wire_attribution")
+    if section is None:
+        return None
+    programs = section.get("programs") if isinstance(section, dict) else None
+    if not isinstance(programs, dict):
+        raise SystemExit(
+            f"shardcheck: malformed wire_attribution section in {path}: "
+            "expected {'comment': ..., 'programs': {...}} — regenerate "
+            "with scripts/shardcheck.py --update-baseline"
+        )
+    return programs
+
+
+def write_wire_baseline(path: Optional[str], wires: Dict[str, dict]) -> None:
+    path = path or progprofile_baseline_path()
+    doc = _read_profile_doc(path)
+    doc.setdefault("comment", _PROGPROFILE_COMMENT)
+    doc["wire_attribution"] = {
         "comment": (
-            "progcheck J004 baseline: the static wire/footprint profile "
-            "(collective bytes, peak live-buffer estimate) of every "
-            "registered program, computed from jaxpr shapes x itemsize. "
-            "Deterministic for a fixed program: any drift is a real "
-            "cost-model change. Refresh with "
-            "`python scripts/progcheck.py --update-baseline` and justify "
-            "the delta in the commit message."
+            "shardcheck S004 baseline: per-mesh-axis and per-domain "
+            "(ICI vs DCN, by axis-name convention) static wire "
+            "attribution of every registered program. per_axis bills "
+            "full operand bytes to every axis a collective crosses; "
+            "per_domain bills each collective once to its most "
+            "expensive domain, so it sums to J004's collective total. "
+            "Refresh with `python scripts/shardcheck.py "
+            "--update-baseline` and justify the delta in the commit "
+            "message."
         ),
-        "profiles": {k: profiles[k] for k in sorted(profiles)},
+        "programs": {k: wires[k] for k in sorted(wires)},
     }
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(payload, fh, indent=2, sort_keys=True)
-        fh.write("\n")
+    _write_profile_doc(path, doc)
 
 
 def progprofile_hash(path: Optional[str] = None) -> Optional[str]:

@@ -661,6 +661,14 @@ def _parser() -> argparse.ArgumentParser:
         "programs that are no longer registered",
     )
     p.add_argument(
+        "--check-baseline",
+        action="store_true",
+        help="baseline hygiene only (parity with gridlint's): report "
+        "entries in the J004 profiles and S004 wire_attribution "
+        "sections for programs that are no longer registered, without "
+        "tracing anything or gating new findings",
+    )
+    p.add_argument(
         "--update-baseline",
         action="store_true",
         help="write the current profiles to the baseline file and exit 0",
@@ -687,11 +695,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from mpi_grid_redistribute_tpu.analysis import rules_jaxpr, sarif
     from mpi_grid_redistribute_tpu.analysis.baseline import (
         load_progprofile_baseline,
+        load_wire_baseline,
         progprofile_baseline_path,
         write_progprofile_baseline,
     )
 
     args = _parser().parse_args(argv)
+
+    if args.check_baseline:
+        # hygiene-only mode: stale measurement entries rot silently
+        # unless something gates them on their own — this needs only
+        # the registry NAMES, so nothing is traced. Covers both the
+        # J004 profiles section and shardcheck's S004 wire_attribution
+        # section (they share the file).
+        path = args.baseline or progprofile_baseline_path()
+        profiled = load_progprofile_baseline(path) or {}
+        wired = load_wire_baseline(path) or {}
+        registered = set(default_programs())
+        stale_names = sorted((set(profiled) | set(wired)) - registered)
+        for name in stale_names:
+            sections = [
+                s
+                for s, d in (("profiles", profiled), ("wire_attribution", wired))
+                if name in d
+            ]
+            print(
+                "stale profile baseline entry (program unregistered? "
+                f"remove it): {name} [{', '.join(sections)}]"
+            )
+        print(
+            f"progcheck: {len(stale_names)} stale baseline entr(y/ies) "
+            f"over {len(set(profiled) | set(wired))}"
+        )
+        return 1 if stale_names else 0
 
     if args.list_rules:
         for rid in J_RULE_IDS:

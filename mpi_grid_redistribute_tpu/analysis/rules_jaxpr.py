@@ -5,21 +5,23 @@ Split from :mod:`.progcheck` the way gridlint splits rule bodies from
 this module owns what each rule MEANS. Everything here operates on
 already-traced jaxprs — importing it never touches device state.
 
-The one analysis with real machinery is J001's replication pass. The
-naive reading of "cond branches must issue identical collectives" would
-condemn the repo's own count-driven engines: the sparse dispatch cond
-deliberately carries ``all_to_all`` at B columns in one branch and at
-the dense pool width in the other, and the neighbor cond has ppermute
-on one side only. Those are SAFE because the predicate is the
+The one analysis with real machinery is J001's replication question.
+The naive reading of "cond branches must issue identical collectives"
+would condemn the repo's own count-driven engines: the sparse dispatch
+cond deliberately carries ``all_to_all`` at B columns in one branch and
+at the dense pool width in the other, and the neighbor cond has
+ppermute on one side only. Those are SAFE because the predicate is the
 one-scalar globally-agreed guard — ``ok`` reduced through ``lax.pmin``
 — so every rank takes the SAME branch and the schedules never
 interleave across ranks. J001 therefore fires only when branch
 schedules mismatch AND the predicate is not provably replicated, where
-"provably replicated" is a forward dataflow pass: values descended
-(through elementwise ops) from replicated reductions (``psum``/
-``pmin``/``pmax``/``pmean``/``all_gather``), literals, or closed-over
-constants are replicated; ``axis_index``, ``ppermute``, ``all_to_all``
-outputs and raw shard_map inputs are not.
+"provably replicated" is answered by the shared per-mesh-axis vary-set
+interpreter in :mod:`.shardcheck` (which grew out of the boolean
+replication pass that used to live here): the predicate's inferred
+vary-set must be empty. The collective vocabulary
+(``COLLECTIVE_PRIMS``, :func:`collective_axes`,
+:func:`collective_signature`) lives in shardcheck too and is
+re-exported here for compatibility.
 """
 
 from __future__ import annotations
@@ -38,6 +40,17 @@ from mpi_grid_redistribute_tpu.analysis.progcheck import (
     jaxpr_of,
     subjaxprs,
     walk_eqns,
+)
+from mpi_grid_redistribute_tpu.analysis.shardcheck import (
+    COLLECTIVE_PRIMS,
+    collective_axes,
+    collective_signature,
+)
+from mpi_grid_redistribute_tpu.analysis.shardcheck import (  # noqa: F401
+    CALL_PRIMS as _CALL_PRIMS,
+    REPLICATING_PRIMS as _REPLICATING_PRIMS,
+    VARYING_PRIMS as _VARYING_PRIMS,
+    _sig_entry,
 )
 
 RULE_DOCS = {
@@ -61,76 +74,7 @@ RULE_DOCS = {
     "progprofile_baseline.json",
 }
 
-# Cross-device communication primitives (jax 0.4.x jaxpr names).
-COLLECTIVE_PRIMS = frozenset(
-    {
-        "psum",
-        "pmax",
-        "pmin",
-        "pmean",
-        "ppermute",
-        "pshuffle",
-        "all_to_all",
-        "all_gather",
-        "all_gather_invariant",
-        "psum_scatter",
-        "reduce_scatter",
-        "pbroadcast",
-    }
-)
-
-# Collectives whose OUTPUT is identical on every rank of the reduced
-# axes — the ancestry that makes a cond predicate "globally agreed".
-_REPLICATING_PRIMS = frozenset(
-    {"psum", "pmax", "pmin", "pmean", "all_gather", "all_gather_invariant",
-     "pbroadcast"}
-)
-
-# Per-rank-varying sources: outputs are never replicated.
-_VARYING_PRIMS = frozenset(
-    {"axis_index", "ppermute", "pshuffle", "all_to_all", "psum_scatter",
-     "reduce_scatter"}
-)
-
-# Call-like HOFs whose body invars map 1:1 onto eqn invars.
-_CALL_PRIMS = frozenset(
-    {"pjit", "closed_call", "core_call", "xla_call", "remat", "remat2",
-     "checkpoint", "custom_jvp_call", "custom_vjp_call", "custom_vmap_call"}
-)
-
 _HOST_SYNC_MARKERS = ("callback", "infeed", "outfeed", "debug")
-
-
-def collective_axes(eqn) -> Tuple[str, ...]:
-    """The mesh axes a collective eqn communicates over (``axes`` for the
-    reductions, ``axis_name`` for ppermute/all_to_all), normalized."""
-    axes = eqn.params.get("axes", eqn.params.get("axis_name"))
-    if axes is None:
-        return ()
-    if isinstance(axes, (tuple, list)):
-        return tuple(str(a) for a in axes)
-    return (str(axes),)
-
-
-def _sig_entry(eqn) -> str:
-    shapes = ",".join(
-        f"{np.dtype(v.aval.dtype).name}[{'x'.join(map(str, v.aval.shape))}]"
-        for v in eqn.invars
-        if hasattr(getattr(v, "aval", None), "shape")
-    )
-    return f"{eqn.primitive.name}@({','.join(collective_axes(eqn))}) {shapes}"
-
-
-def collective_signature(jaxpr) -> Tuple[str, ...]:
-    """Ordered collective schedule of a (sub)jaxpr: one entry per
-    collective eqn, in depth-first trace order — primitive + axes +
-    operand shape/dtype. Two branches with equal signatures issue the
-    same wire schedule on every rank."""
-    return tuple(
-        _sig_entry(e)
-        for e in walk_eqns(jaxpr)
-        if e.primitive.name in COLLECTIVE_PRIMS
-    )
 
 
 # ---------------------------------------------------------------------
@@ -142,149 +86,33 @@ def _is_literal(atom) -> bool:
     return hasattr(atom, "val")  # core.Literal; Vars have no .val
 
 
-class _ReplPass:
-    """Forward replication-propagation over one traced program.
+def check_j001(closed, spec: ProgramSpec) -> List[ProgFinding]:
+    """One :func:`shardcheck.analyze` pass records every cond site with
+    its predicate vary-set and per-branch collective signatures; J001
+    fires where the schedules mismatch and the vary-set is non-empty
+    (the predicate is not provably identical on every rank)."""
+    from mpi_grid_redistribute_tpu.analysis import shardcheck
 
-    Walks every jaxpr once (scan/while bodies to carry fixpoint),
-    maintaining var -> "is this value identical on every rank" and
-    emitting J001 findings at each cond whose branch collective
-    signatures mismatch while the predicate is not replicated.
-    Conservative in both directions that matter: unknown primitives
-    with sub-jaxprs poison their outputs to non-replicated, and
-    shard_map body inputs start non-replicated (each device sees its
-    own shard)."""
-
-    def __init__(self, program: str):
-        self.program = program
-        self.findings: Set[ProgFinding] = set()
-
-    def run(self, closed) -> None:
-        j = jaxpr_of(closed)
-        # top-level invars are host-passed arrays: identical everywhere
-        self._jaxpr(j, [True] * len(j.invars))
-
-    # -- core walk ----------------------------------------------------
-
-    def _jaxpr(self, jaxpr, in_repl: List[bool]) -> List[bool]:
-        repl: Dict[object, bool] = {}
-        for v, r in zip(jaxpr.invars, in_repl):
-            repl[v] = bool(r)
-        for v in jaxpr.constvars:
-            repl[v] = True
-
-        def get(atom) -> bool:
-            if _is_literal(atom):
-                return True
-            return repl.get(atom, False)
-
-        for eqn in jaxpr.eqns:
-            name = eqn.primitive.name
-            ins = [get(a) for a in eqn.invars]
-            if name == "cond":
-                outs = self._cond(eqn, ins, get)
-            elif name == "scan":
-                outs = self._scan(eqn, ins)
-            elif name == "while":
-                outs = self._while(eqn, ins)
-            elif name == "shard_map":
-                body = jaxpr_of(eqn.params["jaxpr"])
-                self._jaxpr(body, [False] * len(body.invars))
-                outs = [False] * len(eqn.outvars)
-            elif name in _CALL_PRIMS:
-                subs = [jaxpr_of(s) for s in subjaxprs(eqn)]
-                if subs and len(subs[0].invars) == len(eqn.invars):
-                    outs = self._jaxpr(subs[0], ins)
-                    for extra in subs[1:]:
-                        self._jaxpr(extra, [False] * len(extra.invars))
-                else:
-                    outs = self._opaque(eqn)
-            elif name in _REPLICATING_PRIMS:
-                outs = [True] * len(eqn.outvars)
-            elif name in _VARYING_PRIMS:
-                outs = [False] * len(eqn.outvars)
-            else:
-                subs = list(subjaxprs(eqn))
-                if subs:
-                    outs = self._opaque(eqn)
-                else:
-                    # elementwise/default: replicated iff every input is
-                    v = all(ins) if ins else True
-                    outs = [v] * len(eqn.outvars)
-            for v, r in zip(eqn.outvars, outs):
-                repl[v] = r
-        return [get(v) for v in jaxpr.outvars]
-
-    def _opaque(self, eqn) -> List[bool]:
-        for sub in subjaxprs(eqn):
-            s = jaxpr_of(sub)
-            self._jaxpr(s, [False] * len(s.invars))
-        return [False] * len(eqn.outvars)
-
-    # -- HOFs ---------------------------------------------------------
-
-    def _cond(self, eqn, ins: List[bool], get) -> List[bool]:
-        pred_repl = get(eqn.invars[0])
-        branches = branch_jaxprs(eqn)
-        branch_outs = [self._jaxpr(b, ins[1:]) for b in branches]
-        sigs = [collective_signature(b) for b in branches]
-        if any(sigs) and len(set(sigs)) > 1 and not pred_repl:
+    report = shardcheck.analyze(closed)
+    findings: Set[ProgFinding] = set()
+    for site in report.conds:
+        sigs = site.signatures
+        if any(sigs) and len(set(sigs)) > 1 and site.pred_vary:
             detail = "; ".join(
                 f"branch{i}=[{', '.join(s) if s else ''}]"
                 for i, s in enumerate(sigs)
             )
-            self.findings.add(
+            findings.add(
                 ProgFinding(
                     "J001",
-                    self.program,
+                    spec.name,
                     "cond branches issue mismatched collective schedules "
                     "and the predicate is not provably replicated (no "
                     "pmin/psum-agreed one-scalar guard): ranks can "
                     f"diverge and deadlock the mesh — {detail}",
                 )
             )
-        n_out = len(eqn.outvars)
-        return [
-            pred_repl and all(bo[i] for bo in branch_outs)
-            for i in range(n_out)
-        ]
-
-    def _scan(self, eqn, ins: List[bool]) -> List[bool]:
-        body = jaxpr_of(eqn.params["jaxpr"])
-        nc = int(eqn.params["num_consts"])
-        ncar = int(eqn.params["num_carry"])
-        consts, carry, xs = ins[:nc], ins[nc : nc + ncar], ins[nc + ncar :]
-        # carry fixpoint: a carry slot is replicated only if it stays
-        # replicated through the body (monotone, so this terminates)
-        for _ in range(ncar + 1):
-            outs = self._jaxpr(body, consts + carry + xs)
-            new_carry = [c and o for c, o in zip(carry, outs[:ncar])]
-            if new_carry == carry:
-                break
-            carry = new_carry
-        return carry + outs[ncar:]
-
-    def _while(self, eqn, ins: List[bool]) -> List[bool]:
-        cond_j = jaxpr_of(eqn.params["cond_jaxpr"])
-        body_j = jaxpr_of(eqn.params["body_jaxpr"])
-        cn = int(eqn.params["cond_nconsts"])
-        bn = int(eqn.params["body_nconsts"])
-        cond_consts = ins[:cn]
-        body_consts = ins[cn : cn + bn]
-        carry = ins[cn + bn :]
-        for _ in range(len(carry) + 1):
-            self._jaxpr(cond_j, cond_consts + carry)
-            outs = self._jaxpr(body_j, body_consts + carry)
-            new_carry = [c and o for c, o in zip(carry, outs)]
-            if new_carry == carry:
-                break
-            carry = new_carry
-        return carry
-
-
-def check_j001(closed, spec: ProgramSpec) -> List[ProgFinding]:
-    p = _ReplPass(spec.name)
-    p.run(closed)
-    return sorted(p.findings, key=lambda f: f.message)
+    return sorted(findings, key=lambda f: f.message)
 
 
 # ---------------------------------------------------------------------

@@ -1,0 +1,291 @@
+"""S-rule bodies over :mod:`.shardcheck` reports (replication rules).
+
+Split from :mod:`.shardcheck` the way rules_jaxpr splits from
+progcheck: shardcheck owns the interpreter, runner and CLI; this module
+owns what each rule MEANS. S001-S003 judge the program points the
+interpreter recorded (escapes, redundant reductions); S004 is its own
+recursive walk — it extends J004's byte model by billing every
+collective's bytes to the mesh axis it crosses and rolling the axes up
+into an ICI-vs-DCN table, the split ROADMAP item 2's two-level mesh
+will gate against.
+
+The domain rollup is by axis-name convention: an axis named like a
+cross-pod link (``dcn``, ``pod``/``pods``, ``slice``/``slices``,
+``wan``) bills to DCN; everything else is ICI. Today every registered
+mesh is single-pod, so the DCN column is structurally zero — the
+mechanism exists so the hierarchical-mesh PR changes a TABLE, not the
+analyzer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from mpi_grid_redistribute_tpu.analysis.progcheck import (
+    ProgramSpec,
+    aval_bytes,
+    branch_jaxprs,
+    jaxpr_of,
+    subjaxprs,
+)
+from mpi_grid_redistribute_tpu.analysis.shardcheck import (
+    COLLECTIVE_PRIMS,
+    ShardFinding,
+    ShardReport,
+    collective_axes,
+)
+
+RULE_DOCS = {
+    "S001": "output-replication consistency: shard_map outputs declared "
+    "fully replicated (out_specs P()) must be provably replicated on "
+    "all mesh axes",
+    "S002": "redundant collective: a full psum/pmin/pmax/pmean whose "
+    "operand is already replicated on a reduced axis pays wire for a "
+    "locally computable value (journal-suppressed via "
+    "analysis/shardcheck_baseline.json)",
+    "S003": "varying-value escape: a value still varying on some mesh "
+    "axis reaches a scan ys leaf or program output the host reads "
+    "unreduced",
+    "S004": "per-axis static wire attribution drift: collective bytes "
+    "billed to the mesh axis crossed (ICI-vs-DCN rollup) must match "
+    "the wire_attribution section of progprofile_baseline.json",
+}
+
+
+# ---------------------------------------------------------------------
+# S001 — output-replication consistency
+# ---------------------------------------------------------------------
+
+
+def check_s001(report: ShardReport, spec: ProgramSpec) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    for e in report.escapes:
+        if e.kind != "replicated_out":
+            continue
+        out.append(
+            ShardFinding(
+                "S001",
+                spec.name,
+                f"shard_map output {e.index} is declared fully "
+                "replicated (out_specs P()) but provably varies over "
+                f"mesh axes {list(e.axes)}: the host-visible value is "
+                "rank-dependent — reduce it (psum/pmin) before the "
+                "boundary or partition the out_spec",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------
+# S002 — redundant collectives (wire-cost optimization flags)
+# ---------------------------------------------------------------------
+
+
+def check_s002(report: ShardReport, spec: ProgramSpec) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    for r in report.reductions:
+        ax = list(r.redundant_axes)
+        out.append(
+            ShardFinding(
+                "S002",
+                spec.name,
+                f"redundant {r.prim} over axes {ax}: the operand is "
+                "already replicated there, so the collective pays "
+                f"{r.operand_bytes} wire bytes per call for a value "
+                "every rank holds (psum of a replicated x is a local "
+                "x * axis_size; pmin/pmax/pmean are the identity) — "
+                "drop it or reduce only the varying axes",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------
+# S003 — varying-value escapes to host-visible surfaces
+# ---------------------------------------------------------------------
+
+_ESCAPE_SURFACE = {
+    "scan_ys": "scan ys leaf",
+    "output": "program output",
+}
+
+
+def check_s003(report: ShardReport, spec: ProgramSpec) -> List[ShardFinding]:
+    out: List[ShardFinding] = []
+    for e in report.escapes:
+        surface = _ESCAPE_SURFACE.get(e.kind)
+        if surface is None:
+            continue
+        out.append(
+            ShardFinding(
+                "S003",
+                spec.name,
+                f"{surface} {e.index} carries a value still varying "
+                f"over mesh axes {list(e.axes)}: the host reads it "
+                "unreduced, so the result depends on which rank's "
+                "shard wins — reduce it on-device or partition it "
+                "explicitly",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------
+# S004 — per-axis / per-domain static wire attribution
+# ---------------------------------------------------------------------
+
+ICI_DOMAIN = "ici"
+DCN_DOMAIN = "dcn"
+
+# Axis names that denote a cross-pod (data-center-network) link under
+# the two-level-mesh naming convention; everything else is on-chip ICI.
+DCN_AXIS_TOKENS = frozenset({"dcn", "pod", "pods", "slice", "slices", "wan"})
+
+
+def axis_domain(axis: str) -> str:
+    return DCN_DOMAIN if str(axis).lower() in DCN_AXIS_TOKENS else ICI_DOMAIN
+
+
+def _merge(total: Dict[str, int], add: Dict[str, int], mult: int = 1):
+    for k, v in add.items():
+        total[k] = total.get(k, 0) + v * mult
+
+
+def _wire_cost(jaxpr) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(bytes per mesh axis, bytes per domain) for one jaxpr, same
+    billing discipline as J004's ``_collective_cost``: scan bodies
+    multiplied by trip count, cond billed at the max-bytes branch,
+    while bodies at one trip. Per-axis bills the FULL operand bytes to
+    EVERY axis the collective crosses (the axis-crossing view, so a
+    2-axis all_to_all shows on both axes); per-domain bills each
+    collective once, to the most expensive domain it touches (DCN over
+    ICI), so the domain column sums to J004's collective total."""
+    per_axis: Dict[str, int] = {}
+    per_domain: Dict[str, int] = {ICI_DOMAIN: 0, DCN_DOMAIN: 0}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "cond":
+            best_axis: Dict[str, int] = {}
+            best_domain: Dict[str, int] = {ICI_DOMAIN: 0, DCN_DOMAIN: 0}
+            best_bytes = -1
+            for b in branch_jaxprs(eqn):
+                a, d = _wire_cost(b)
+                s = sum(d.values())
+                if s > best_bytes:
+                    best_bytes, best_axis, best_domain = s, a, d
+            _merge(per_axis, best_axis)
+            _merge(per_domain, best_domain)
+        elif name == "scan":
+            mult = int(eqn.params.get("length", 1))
+            for sub in subjaxprs(eqn):
+                a, d = _wire_cost(jaxpr_of(sub))
+                _merge(per_axis, a, mult)
+                _merge(per_domain, d, mult)
+        elif name in COLLECTIVE_PRIMS:
+            b = sum(aval_bytes(v.aval) for v in eqn.invars)
+            axes = collective_axes(eqn)
+            for a in axes:
+                per_axis[a] = per_axis.get(a, 0) + b
+            if axes:
+                dom = (
+                    DCN_DOMAIN
+                    if any(axis_domain(a) == DCN_DOMAIN for a in axes)
+                    else ICI_DOMAIN
+                )
+                per_domain[dom] += b
+        else:
+            for sub in subjaxprs(eqn):
+                a, d = _wire_cost(jaxpr_of(sub))
+                _merge(per_axis, a)
+                _merge(per_domain, d)
+    return per_axis, per_domain
+
+
+def wire_profile(closed) -> dict:
+    """The S004 attribution for one traced program — deterministic for
+    a fixed program, so the baseline compare is exact."""
+    per_axis, per_domain = _wire_cost(jaxpr_of(closed))
+    return {
+        "per_axis": {k: int(per_axis[k]) for k in sorted(per_axis)},
+        "per_domain": {k: int(per_domain[k]) for k in sorted(per_domain)},
+        "total_bytes": int(sum(per_domain.values())),
+    }
+
+
+def _drifted(old: int, new: int, rtol: float) -> bool:
+    if old == new:
+        return False
+    if rtol <= 0:
+        return True
+    return abs(new - old) > rtol * max(abs(old), 1)
+
+
+def compare_wire(
+    current: Dict[str, dict],
+    baseline: Optional[Dict[str, dict]],
+    rtol: float = 0.0,
+    check_stale: bool = False,
+    partial: bool = False,
+) -> List[ShardFinding]:
+    """Drift gate over the wire attributions, mirroring J004's
+    ``compare_profiles``: any numeric drift beyond ``rtol`` (default:
+    exact) is an S004 finding — intentional changes re-commit via
+    ``scripts/shardcheck.py --update-baseline``."""
+    findings: List[ShardFinding] = []
+    if baseline is None:
+        baseline = {}
+    for name in sorted(current):
+        if name not in baseline:
+            findings.append(
+                ShardFinding(
+                    "S004",
+                    name,
+                    "program has no committed wire-attribution baseline "
+                    "— run scripts/shardcheck.py --update-baseline and "
+                    "commit analysis/progprofile_baseline.json",
+                )
+            )
+            continue
+        cur, base = current[name], baseline[name]
+        old_t, new_t = int(base.get("total_bytes", 0)), int(
+            cur.get("total_bytes", 0)
+        )
+        if _drifted(old_t, new_t, rtol):
+            pct = (new_t - old_t) / max(abs(old_t), 1) * 100.0
+            findings.append(
+                ShardFinding(
+                    "S004",
+                    name,
+                    f"total wire bytes drifted: baseline {old_t}, now "
+                    f"{new_t} ({pct:+.1f}%) — a wire-cost change; "
+                    "justify it and refresh with --update-baseline",
+                )
+            )
+        for section, unit in (("per_axis", "axis"), ("per_domain", "domain")):
+            old_c = dict(base.get(section, {}))
+            new_c = dict(cur.get(section, {}))
+            for key in sorted(set(old_c) | set(new_c)):
+                old, new = int(old_c.get(key, 0)), int(new_c.get(key, 0))
+                if _drifted(old, new, rtol):
+                    findings.append(
+                        ShardFinding(
+                            "S004",
+                            name,
+                            f"wire bytes on {unit} {key!r} drifted: "
+                            f"baseline {old}, now {new} — the collective "
+                            "schedule moved across the mesh; justify it "
+                            "and refresh with --update-baseline",
+                        )
+                    )
+    if check_stale and not partial:
+        for name in sorted(set(baseline) - set(current)):
+            findings.append(
+                ShardFinding(
+                    "S004",
+                    name,
+                    "stale wire-attribution baseline entry: program is "
+                    "no longer registered — remove it with "
+                    "--update-baseline",
+                )
+            )
+    return findings

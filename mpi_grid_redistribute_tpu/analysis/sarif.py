@@ -99,6 +99,19 @@ def to_sarif(
     }
 
 
+def merge_sarif(docs: Iterable[dict]) -> dict:
+    """One SARIF document holding every run of several tool outputs —
+    the ``make check`` umbrella concatenates gridlint + progcheck +
+    shardcheck into a single upload this way. Runs keep their own tool
+    metadata; SARIF viewers group results per driver."""
+    runs = [run for doc in docs for run in doc.get("runs", [])]
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": runs,
+    }
+
+
 def github_annotations(findings: Iterable) -> List[str]:
     """GitHub Actions workflow-command lines: printed to stdout inside a
     workflow they render as inline PR annotations, no SARIF upload

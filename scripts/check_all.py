@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Umbrella CI gate: gridlint + progcheck + shardcheck, one SARIF file.
+
+Usage:
+    python scripts/check_all.py [--sarif-out PATH]
+
+Runs all three analyzers in ``--check`` mode (each in its own
+subprocess so gridlint stays jax-free and the jaxpr analyzers get the
+forced 8-device virtual CPU mesh from their wrappers), captures their
+SARIF output, and merges the runs into one document via
+``analysis/sarif.py``'s ``merge_sarif`` — a single code-scanning
+upload for ``make check``.
+
+Exit codes: 0 when every tool is clean, 1 when any tool found
+something, 2 on any usage/parse error.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOOLS = (
+    (
+        "gridlint",
+        ["scripts/gridlint.py", "mpi_grid_redistribute_tpu/", "--check",
+         "--format=sarif"],
+    ),
+    ("progcheck", ["scripts/progcheck.py", "--check", "--format=sarif"]),
+    ("shardcheck", ["scripts/shardcheck.py", "--check", "--format=sarif"]),
+)
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, REPO)
+    from mpi_grid_redistribute_tpu.analysis.sarif import merge_sarif
+
+    p = argparse.ArgumentParser(
+        prog="check_all",
+        description="Run gridlint + progcheck + shardcheck and merge "
+        "their SARIF runs into one file.",
+    )
+    p.add_argument(
+        "--sarif-out",
+        default=os.path.join(REPO, "analysis_merged.sarif"),
+        metavar="PATH",
+        help="merged SARIF output path (default: analysis_merged.sarif "
+        "at the repo root)",
+    )
+    args = p.parse_args(argv)
+
+    docs = []
+    worst = 0
+    for name, cmd in TOOLS:
+        proc = subprocess.run(
+            [sys.executable] + cmd,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode == 2:
+            print(f"check: {name} usage/parse error:", file=sys.stderr)
+            sys.stderr.write(proc.stderr)
+            return 2
+        try:
+            doc = json.loads(proc.stdout)
+        except ValueError:
+            print(
+                f"check: {name} produced no parseable SARIF "
+                f"(exit {proc.returncode}):",
+                file=sys.stderr,
+            )
+            sys.stderr.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+            return 2
+        docs.append(doc)
+        n_results = sum(len(r.get("results", [])) for r in doc.get("runs", []))
+        status = "clean" if proc.returncode == 0 else "FAILED"
+        print(
+            f"check: {name} {status} "
+            f"({n_results} finding(s), exit {proc.returncode})"
+        )
+        # stale-baseline notes ride stderr; keep them visible
+        if proc.stderr.strip():
+            sys.stderr.write(proc.stderr)
+        worst = max(worst, proc.returncode)
+
+    merged = merge_sarif(docs)
+    with open(args.sarif_out, "w", encoding="utf-8") as fh:
+        json.dump(merged, fh, indent=2)
+        fh.write("\n")
+    print(
+        f"check: merged {len(merged['runs'])} run(s) -> {args.sarif_out}"
+    )
+    return 1 if worst else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Run shardcheck, the sharding/replication abstract interpreter.
+
+Usage:
+    python scripts/shardcheck.py [--format=json|sarif|github] [--check]
+    python scripts/shardcheck.py --update-baseline
+    python scripts/shardcheck.py --list-rules | --list-programs
+
+shardcheck TRACES the registered entry points with ``jax.make_jaxpr``
+(no device execution) and propagates a per-mesh-axis varying/replicated
+lattice through every eqn, gating S001-S004: replication of declared-
+replicated outputs, redundant collectives, varying-value escapes, and
+the per-axis ICI/DCN wire attribution against the ``wire_attribution``
+section of ``analysis/progprofile_baseline.json``. Like
+scripts/progcheck.py, this wrapper forces the 8-device virtual CPU
+mesh BEFORE jax is imported so ``make shardcheck`` behaves identically
+inside and outside CI.
+
+Exit codes mirror gridlint: 0 clean, 1 findings/drift, 2 usage error.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_grid_redistribute_tpu.analysis.shardcheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -674,3 +674,44 @@ def test_finding_render_and_dict():
     assert f.render() == "<prog>: J001: msg"
     d = f.to_dict()
     assert d["rule"] == "J001" and d["program"] == "prog"
+
+
+def test_check_baseline_clean_on_committed_file(capsys):
+    """--check-baseline hygiene mode: every name in the committed
+    profiles AND wire_attribution sections is a registered program.
+    Pure name check — nothing is traced, so no _devices needed."""
+    rc = progcheck_main(["--check-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 stale baseline entr" in out
+
+
+def test_check_baseline_flags_unregistered_programs(capsys, tmp_path):
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        write_wire_baseline,
+    )
+
+    path = str(tmp_path / "prof.json")
+    write_progprofile_baseline(
+        path,
+        {
+            "canonical_planar_sharded": {"collective_bytes_total": 1},
+            "ghost_profiled": {"collective_bytes_total": 2},
+        },
+    )
+    write_wire_baseline(
+        path,
+        {
+            "ghost_profiled": {"per_axis": {}, "total_bytes": 0},
+            "ghost_wired": {"per_axis": {}, "total_bytes": 0},
+        },
+    )
+    rc = progcheck_main(["--check-baseline", "--baseline", path])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "2 stale baseline entr" in out
+    # each stale name reports WHICH sections still carry it
+    assert "ghost_profiled [profiles, wire_attribution]" in out
+    assert "ghost_wired [wire_attribution]" in out
+    # the registered program is NOT flagged
+    assert "canonical_planar_sharded" not in out
