@@ -2,7 +2,8 @@ PY ?= python
 
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
 	soak soak-smoke rebalance-smoke service-bench progcheck \
-	progcheck-baseline shardcheck shardcheck-baseline check
+	progcheck-baseline shardcheck shardcheck-baseline check \
+	attribution attribution-check
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -81,7 +82,7 @@ service-bench:
 	JAX_PLATFORMS=cpu \
 		$(PY) -m mpi_grid_redistribute_tpu.bench.config10_service --gate
 
-# gridlint: AST-based SPMD/JIT invariant checker (G001-G009), then
+# gridlint: AST-based SPMD/JIT invariant checker (G001-G010), then
 # progcheck: the semantic jaxpr analyzer (J000-J004) over the REAL
 # traced programs, then shardcheck: the sharding/replication abstract
 # interpreter (S001-S004). Exit 0 = clean or fully baselined; 1 = new
@@ -92,10 +93,24 @@ lint:
 	$(PY) scripts/progcheck.py --check
 	$(PY) scripts/shardcheck.py --check
 
-# one-shot CI umbrella: all three analyzers, SARIF runs merged into a
-# single analysis_merged.sarif for one code-scanning upload
+# one-shot CI umbrella: all four analyzers/gates, SARIF runs merged
+# into a single analysis_merged.sarif for one code-scanning upload
 check:
 	$(PY) scripts/check_all.py
+
+# roofline observatory (ISSUE 14): re-measure the knockout phase tables
+# (both engines, both committed shapes) + the XLA cost-model roofline
+# report, rewrite telemetry/attribution_baseline.json, and re-render
+# the BENCH_CONFIGS.md CPU tables from it. Minutes of CPU.
+attribution:
+	$(PY) scripts/attribution.py --update-baseline --render
+
+# attribution drift gate (also inside `make check`): structural only —
+# snapshot exists, phase names/counts match the live knockout
+# definitions, roofline covers every registered program, rendered
+# markdown matches the snapshot. Never re-measures.
+attribution-check:
+	$(PY) scripts/attribution.py --check
 
 # progcheck alone: trace every registered SPMD program on the virtual
 # 8-device CPU mesh and gate J001-J004 plus the static wire/footprint

@@ -44,6 +44,9 @@ _RULE_DOCS = {
     "G009": "no host syncs (np.asarray/.block_until_ready()/float() on "
     "non-literals) inside resident-path-marked functions (chunk "
     "interior stays on device)",
+    "G010": "fastpath-engine/resident-path-marked functions must "
+    "contain at least one named_scope/traced_span (profiler and "
+    "knockout attribution coverage)",
 }
 
 

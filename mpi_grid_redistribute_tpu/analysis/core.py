@@ -32,7 +32,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tupl
 
 RULE_IDS = (
     "G001", "G002", "G003", "G004", "G005", "G006", "G007", "G008",
-    "G009",
+    "G009", "G010",
 )
 
 _SUPPRESS_RE = re.compile(
@@ -717,6 +717,7 @@ def run_gridlint(
         rules_resident,
         rules_scrape,
         rules_service,
+        rules_spans,
     )
 
     project = build_project(paths, root)

@@ -52,6 +52,7 @@ import numpy as np
 from mpi_grid_redistribute_tpu.service.faults import FaultPlan, StallError
 from mpi_grid_redistribute_tpu.telemetry import StepRecorder
 from mpi_grid_redistribute_tpu.telemetry.health import HealthMonitor
+from mpi_grid_redistribute_tpu.telemetry.profiler import ProfilerSession
 from mpi_grid_redistribute_tpu.utils import checkpoint
 
 
@@ -125,6 +126,13 @@ class DriverConfig:
     rebalance_horizon: int = 256      # guard amortization horizon (steps)
     rebalance_cooldown: int = 64      # min steps between applied remaps
     rebalance_min_improvement: float = 0.05
+    # profiler sessions (ISSUE 14): when set (or via GRID_PROFILE_DIR),
+    # run() wraps the whole stepping loop in a
+    # telemetry.profiler.ProfilerSession — one jax.profiler trace into
+    # this directory per run() call, journaled as a profile_session
+    # event. None = off; an unavailable profiler degrades to a no-op
+    # (armed=False in the event), never a crash.
+    profile_dir: Optional[str] = None
 
 
 class ServiceDriver:
@@ -963,21 +971,29 @@ class ServiceDriver:
         if max_steps is not None:
             end = min(end, self.step + int(max_steps))
         pending = None
+        # one profiler trace per run() call when cfg.profile_dir or
+        # GRID_PROFILE_DIR is set; a no-op otherwise (ISSUE 14)
+        session = ProfilerSession(
+            cfg.profile_dir,
+            recorder=self.recorder,
+            label=f"run@{self.step}",
+        )
         try:
-            while self.step < end:
-                self._ensure_built()
-                if pending is not None:
-                    pending = self._retire_chunk(pending, end)
-                    continue
-                n = self._chunk_len_from(self.step, end)
-                if (
-                    n == 1
-                    or cfg.backend != "jax"
-                    or not self._resident_ok()
-                ):
-                    self._run_chunk_eager(n)
-                    continue
-                pending = self._dispatch_chunk(n)
+            with session:
+                while self.step < end:
+                    self._ensure_built()
+                    if pending is not None:
+                        pending = self._retire_chunk(pending, end)
+                        continue
+                    n = self._chunk_len_from(self.step, end)
+                    if (
+                        n == 1
+                        or cfg.backend != "jax"
+                        or not self._resident_ok()
+                    ):
+                        self._run_chunk_eager(n)
+                        continue
+                    pending = self._dispatch_chunk(n)
         finally:
             self._materialize_state()
         return self.state
@@ -1112,6 +1128,12 @@ def main(argv=None) -> int:
         help="crash via os._exit (subprocess kill tests) instead of raise",
     )
     p.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of each run() into DIR "
+             "(telemetry.profiler.ProfilerSession; GRID_PROFILE_DIR is "
+             "the env spelling; journaled as profile_session events)",
+    )
+    p.add_argument(
         "--final-out", default=None,
         help="write the final state (pos/vel/count/step npz) here",
     )
@@ -1144,6 +1166,7 @@ def main(argv=None) -> int:
         rebalance_cells=args.rebalance_cells,
         rebalance_horizon=args.rebalance_horizon,
         rebalance_cooldown=args.rebalance_cooldown,
+        profile_dir=args.profile_dir,
     )
     faults = FaultPlan()
     if args.inject_crash is not None:

@@ -58,6 +58,7 @@ from jax import lax
 from mpi_grid_redistribute_tpu import api
 from mpi_grid_redistribute_tpu.models import nbody
 from mpi_grid_redistribute_tpu.ops import binning, pack
+from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 from mpi_grid_redistribute_tpu.parallel import exchange, migrate
 from mpi_grid_redistribute_tpu.service import resident
 
@@ -270,14 +271,16 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
 
         def body(carry, _):
             T, stack, nf, arr, vac, ns, ni, feas = carry
-            T2, stack2, nf2, key2 = lax.cond(
-                feas,
-                _pipe,
-                _seq,
-                (T, stack, nf, arr, vac, ns, ni),
-            )
-            plan2 = tp.issue(key2, nf2)
-            arr2 = pack.gather_plan_cols(T2, plan2.arr_plan)
+            with traced_span("pipe:land+drift"):
+                T2, stack2, nf2, key2 = lax.cond(
+                    feas,
+                    _pipe,
+                    _seq,
+                    (T, stack, nf, arr, vac, ns, ni),
+                )
+            with traced_span("pipe:issue"):
+                plan2 = tp.issue(key2, nf2)
+                arr2 = pack.gather_plan_cols(T2, plan2.arr_plan)
             ys, feas2 = _step_ys(plan2, nf2)
             carry2 = (
                 T2, stack2, nf2, arr2,

@@ -50,6 +50,7 @@ import jax
 from jax import lax
 
 from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
 class ResidentLayoutError(ValueError):
@@ -99,8 +100,12 @@ def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
     def macro(pos, vel, ids, count):
         def body(carry, _):
             pos, vel, ids, count = carry
-            pos = nbody.service_drift(pos, vel, dt)
-            pos, count, (vel, ids), stats = fn(pos, count, vel, ids)
+            with traced_span("svc:drift"):
+                pos = nbody.service_drift(pos, vel, dt)
+            with traced_span("svc:exchange"):
+                pos, count, (vel, ids), stats = fn(
+                    pos, count, vel, ids
+                )
             ys = {"stats": stats, "count": count}
             return (pos, vel, ids, count), ys
 

@@ -55,6 +55,19 @@ The metrics plane (ISSUE 5) makes the journal scrapable pod-wide:
   (``classify_capture`` — WOBBLE/WARN/REGRESSION against the captures'
   own min-of-k spreads) and ``env_fingerprint()``.
 
+The roofline observatory (ISSUE 14) closes the predicted-vs-achieved
+loop:
+
+* :mod:`.roofline` — per-program analytic rooflines from XLA's own cost
+  model (``Compiled.cost_analysis()`` FLOPs / bytes over the chip roofs
+  in :mod:`..utils.profiling`), cross-checked against the J004/S004
+  static wire model with discrepancies journaled as ``roofline`` events
+  (``scripts/attribution.py`` is the CLI).
+* :mod:`.profiler` — :class:`~.profiler.ProfilerSession`, the gated
+  programmatic ``jax.profiler`` trace wrapper (``GRID_PROFILE_DIR`` /
+  ``DriverConfig.profile_dir``), journaled as ``profile_session``
+  events.
+
 Event schema and metric families: ``telemetry/SCHEMA.md``.
 """
 
@@ -113,4 +126,11 @@ from mpi_grid_redistribute_tpu.telemetry.health import (  # noqa: F401
 from mpi_grid_redistribute_tpu.telemetry.traceview import (  # noqa: F401
     to_chrome_trace,
     write_trace,
+)
+from mpi_grid_redistribute_tpu.telemetry.roofline import (  # noqa: F401
+    format_roofline_table,
+    roofline_report,
+)
+from mpi_grid_redistribute_tpu.telemetry.profiler import (  # noqa: F401
+    ProfilerSession,
 )

@@ -1,0 +1,291 @@
+"""Analytic rooflines from the XLA cost model (ISSUE 14).
+
+The knockout tables (``telemetry/phases.py``) attribute MEASURED time;
+this module supplies the other half of the attribution story: what the
+program SHOULD cost. ``jax.stages.Compiled.cost_analysis()`` exposes
+XLA's own per-program cost model — total FLOPs and bytes accessed —
+which, divided by the chip roofs in ``utils/profiling.py`` (HBM bytes/s,
+summed ICI link bytes/s, peak FLOP/s), yields a predicted step time and
+a bound-by classification per program:
+
+* ``compute``    — FLOPs / peak FLOP/s dominates;
+* ``memory``     — bytes accessed / HBM peak dominates;
+* ``collective`` — the J004 static collective bytes / the ICI roof
+  dominates (the wire, not the local traffic).
+
+``roofline_report()`` runs this over every progcheck-registered program
+and CROSS-CHECKS the cost model against the committed static wire model
+(J004 ``profiles`` + S004 ``wire_attribution`` sections of
+``analysis/progprofile_baseline.json``): XLA's bytes-accessed figure
+must cover at least the collective payload the jaxpr schedules — when it
+does not (or when the backend has no cost model at all), the row is
+journaled as a ``roofline`` event with ``discrepancy`` set, never
+silently dropped. Passing measured min-of-k step seconds adds the
+``achieved_fraction`` column (predicted/measured — how much of the
+analytic roof the program realizes), which ``metrics.from_journal``
+surfaces as the ``grid_roofline_achieved_fraction`` gauge.
+
+Everything numeric is pure hand-math (``predict()``), unit-tested
+against synthetic cost dicts; only :func:`program_cost` touches jax.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from mpi_grid_redistribute_tpu.utils import profiling
+
+# bound-by verdicts, in predict() tie-break order
+BOUND_COMPUTE = "compute"
+BOUND_MEMORY = "memory"
+BOUND_COLLECTIVE = "collective"
+BOUND_UNKNOWN = "unknown"  # no cost model available on this backend
+
+
+def extract_cost(cost_analysis) -> Optional[Dict[str, float]]:
+    """Normalize a ``Compiled.cost_analysis()`` result to
+    ``{"flops": float, "bytes_accessed": float}``.
+
+    jax versions disagree about the container (a dict, or a 1-list of
+    dicts) and backends disagree about coverage (a key may be absent —
+    reported as 0.0, distinct from the whole model being absent, which
+    returns ``None``).
+    """
+    if cost_analysis is None:
+        return None
+    if isinstance(cost_analysis, (list, tuple)):
+        if not cost_analysis:
+            return None
+        cost_analysis = cost_analysis[0]
+    if not isinstance(cost_analysis, dict):
+        return None
+    return {
+        "flops": float(cost_analysis.get("flops", 0.0)),
+        "bytes_accessed": float(cost_analysis.get("bytes accessed", 0.0)),
+    }
+
+
+def predict(
+    cost: Optional[Dict[str, float]],
+    collective_bytes: int = 0,
+    *,
+    peak_flops_per_sec: float = profiling.PEAK_FLOPS_PER_SEC,
+    peak_bytes_per_sec: float = profiling.HBM_PEAK_BYTES_PER_SEC,
+    collective_peak_bytes_per_sec: float = (
+        profiling.ICI_LINK_BYTES_PER_SEC * profiling.ICI_LINKS_PER_CHIP
+    ),
+) -> Dict[str, object]:
+    """Roofline prediction for one program (pure hand-math).
+
+    Args:
+      cost: :func:`extract_cost` output (``None`` = no cost model).
+      collective_bytes: the J004 static collective byte total — billed
+        against the ICI roof separately from local bytes, because the
+        wire and HBM are independent resources.
+
+    Returns a dict with ``t_compute_s`` / ``t_memory_s`` /
+    ``t_collective_s``, their max ``t_predicted_s``, and the ``bound_by``
+    verdict (the resource whose roof the max came from; ties break
+    compute < memory < collective so a 0-cost program reads
+    ``compute``-bound at 0 s rather than inventing a wall).
+    """
+    t_coll = float(collective_bytes) / collective_peak_bytes_per_sec
+    if cost is None:
+        return {
+            "flops": None,
+            "bytes_accessed": None,
+            "t_compute_s": None,
+            "t_memory_s": None,
+            "t_collective_s": t_coll,
+            "t_predicted_s": t_coll,
+            "bound_by": BOUND_UNKNOWN,
+        }
+    t_comp = cost["flops"] / peak_flops_per_sec
+    t_mem = cost["bytes_accessed"] / peak_bytes_per_sec
+    t_pred = max(t_comp, t_mem, t_coll)
+    if t_pred == t_comp:
+        bound = BOUND_COMPUTE
+    elif t_pred == t_mem:
+        bound = BOUND_MEMORY
+    else:
+        bound = BOUND_COLLECTIVE
+    return {
+        "flops": cost["flops"],
+        "bytes_accessed": cost["bytes_accessed"],
+        "t_compute_s": t_comp,
+        "t_memory_s": t_mem,
+        "t_collective_s": t_coll,
+        "t_predicted_s": t_pred,
+        "bound_by": bound,
+    }
+
+
+def program_cost(spec) -> Optional[Dict[str, float]]:
+    """Compile one progcheck :class:`~..analysis.progcheck.ProgramSpec`
+    and read XLA's cost model. Returns ``None`` when the backend
+    provides no cost analysis (degraded, not fatal — the report marks
+    the row ``bound_by="unknown"`` and journals the discrepancy)."""
+    import jax
+
+    fn, args = spec.build()
+    compiled = jax.jit(fn).lower(*args).compile()
+    try:
+        return extract_cost(compiled.cost_analysis())
+    except Exception:
+        return None
+
+
+def cross_check(
+    cost: Optional[Dict[str, float]],
+    static_profile: Optional[dict],
+    wire: Optional[dict],
+) -> Dict[str, object]:
+    """Cost-model vs static-wire-model consistency verdict for one
+    program.
+
+    The jaxpr-derived J004 collective byte total is a LOWER bound on
+    real memory traffic (every wired byte is read and written at least
+    once), so ``bytes_accessed < collective_bytes_total`` means one of
+    the two models is wrong — as does a missing cost model. Either way
+    the caller journals it; nothing is silently dropped.
+    """
+    static_bytes = None
+    ici_bytes = None
+    if static_profile is not None:
+        static_bytes = int(static_profile.get("collective_bytes_total", 0))
+    if wire is not None:
+        ici_bytes = int(wire.get("per_domain", {}).get("ici", 0))
+    if cost is None:
+        return {
+            "static_collective_bytes": static_bytes,
+            "static_ici_bytes": ici_bytes,
+            "bytes_ratio": None,
+            "discrepancy": True,
+            "discrepancy_reason": "no cost model on this backend",
+        }
+    if static_bytes is None:
+        return {
+            "static_collective_bytes": None,
+            "static_ici_bytes": ici_bytes,
+            "bytes_ratio": None,
+            "discrepancy": True,
+            "discrepancy_reason": "program missing from the J004 baseline"
+            " — run scripts/progcheck.py --update-baseline",
+        }
+    ratio = (
+        cost["bytes_accessed"] / static_bytes if static_bytes > 0 else None
+    )
+    if static_bytes > 0 and cost["bytes_accessed"] < static_bytes:
+        return {
+            "static_collective_bytes": static_bytes,
+            "static_ici_bytes": ici_bytes,
+            "bytes_ratio": ratio,
+            "discrepancy": True,
+            "discrepancy_reason": (
+                "cost-model bytes accessed "
+                f"({cost['bytes_accessed']:.0f}) below the static "
+                f"collective total ({static_bytes}) — one model is wrong"
+            ),
+        }
+    return {
+        "static_collective_bytes": static_bytes,
+        "static_ici_bytes": ici_bytes,
+        "bytes_ratio": ratio,
+        "discrepancy": False,
+        "discrepancy_reason": "",
+    }
+
+
+def roofline_report(
+    programs: Optional[dict] = None,
+    measured_s: Optional[Dict[str, float]] = None,
+    recorder=None,
+    *,
+    peak_flops_per_sec: float = profiling.PEAK_FLOPS_PER_SEC,
+    peak_bytes_per_sec: float = profiling.HBM_PEAK_BYTES_PER_SEC,
+) -> Dict[str, dict]:
+    """Predicted-vs-achieved roofline rows for every registered program.
+
+    Args:
+      programs: progcheck registry subset (default: all 13 registered
+        programs via ``analysis.progcheck.default_programs()``).
+      measured_s: optional ``{program: min-of-k step seconds}`` — fills
+        ``measured_s`` and ``achieved_fraction`` (predicted/measured).
+      recorder: optional ``StepRecorder`` — every row is journaled as a
+        ``roofline`` event (discrepant rows included, per SCHEMA.md).
+
+    Returns ``{program: row}`` where each row merges :func:`predict`
+    and :func:`cross_check` outputs plus the achieved columns.
+    """
+    from mpi_grid_redistribute_tpu.analysis import progcheck
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        load_progprofile_baseline,
+        load_wire_baseline,
+    )
+
+    programs = progcheck.default_programs() if programs is None else programs
+    measured_s = measured_s or {}
+    static = load_progprofile_baseline() or {}
+    wires = load_wire_baseline() or {}
+    report: Dict[str, dict] = {}
+    for name in sorted(programs):
+        cost = program_cost(programs[name])
+        prof = static.get(name)
+        coll = int(prof.get("collective_bytes_total", 0)) if prof else 0
+        row = predict(
+            cost,
+            coll,
+            peak_flops_per_sec=peak_flops_per_sec,
+            peak_bytes_per_sec=peak_bytes_per_sec,
+        )
+        row.update(cross_check(cost, prof, wires.get(name)))
+        meas = measured_s.get(name)
+        row["measured_s"] = meas
+        row["achieved_fraction"] = (
+            None
+            if meas is None or not row["t_predicted_s"] or meas <= 0
+            else row["t_predicted_s"] / meas
+        )
+        report[name] = row
+        if recorder is not None:
+            recorder.record(
+                "roofline",
+                program=name,
+                phase="total",
+                flops=row["flops"],
+                bytes_accessed=row["bytes_accessed"],
+                t_predicted_s=row["t_predicted_s"],
+                bound_by=row["bound_by"],
+                static_collective_bytes=row["static_collective_bytes"],
+                bytes_ratio=row["bytes_ratio"],
+                discrepancy=row["discrepancy"],
+                discrepancy_reason=row["discrepancy_reason"],
+                measured_s=meas,
+                achieved_fraction=row["achieved_fraction"],
+            )
+    return report
+
+
+def format_roofline_table(report: Dict[str, dict]) -> str:
+    """Markdown roofline table (one row per program)."""
+    lines = [
+        "| program | flops | bytes | pred ms | bound by | achieved | "
+        "xcheck |",
+        "|---|---|---|---|---|---|---|",
+    ]
+
+    def _num(v, scale=1.0, fmt="{:.2f}"):
+        return "—" if v is None else fmt.format(v * scale)
+
+    for name in sorted(report):
+        r = report[name]
+        xc = "DISCREPANT" if r["discrepancy"] else "ok"
+        lines.append(
+            f"| {name} | {_num(r['flops'], 1e-6)}M "
+            f"| {_num(r['bytes_accessed'], 1e-6)}MB "
+            f"| {_num(r['t_predicted_s'], 1e3, '{:.4f}')} "
+            f"| {r['bound_by']} "
+            f"| {_num(r['achieved_fraction'], 100.0)}% "
+            f"| {xc} |"
+        )
+    return "\n".join(lines)

@@ -60,6 +60,7 @@ def to_chrome_trace(
     recorder=None,
     phase_timings: Optional[Sequence] = None,
     step_seconds: Optional[float] = None,
+    annotations: Optional[Dict[str, dict]] = None,
 ) -> Dict[str, object]:
     """Build one Trace Event Format dict from telemetry sources.
 
@@ -71,6 +72,11 @@ def to_chrome_trace(
         (``attribute_phases`` output) for the duration lane (pid 1).
       step_seconds: honest per-step seconds for the counter track's
         synthetic time axis (default 1 ms per step).
+      annotations: optional ``{phase_name: {key: value}}`` cost context
+        (roofline flops/bytes/bound-by — see ``telemetry.roofline``)
+        merged into the matching pid-1 duration event's ``args`` so the
+        Perfetto tooltip shows what the phase SHOULD cost next to what
+        it measured. Keys never overwrite the measured columns.
 
     Returns a JSON-serializable dict; every event carries the required
     ``ph``/``ts``/``pid`` keys (schema-checked in ``tests/test_flow.py``).
@@ -118,6 +124,10 @@ def to_chrome_trace(
             x = getattr(row, "x_roofline", None)
             if x is not None:
                 args["x_roofline"] = float(x)
+            extra = (annotations or {}).get(str(row.phase))
+            if extra:
+                for k, v in extra.items():
+                    args.setdefault(str(k), _json_safe(v))
             events.append(
                 {
                     "name": str(row.phase),
@@ -158,11 +168,15 @@ def write_trace(
     recorder=None,
     phase_timings: Optional[Sequence] = None,
     step_seconds: Optional[float] = None,
+    annotations: Optional[Dict[str, dict]] = None,
 ) -> int:
     """Write :func:`to_chrome_trace` JSON to ``path``; returns the number
     of trace events written (metadata included)."""
     trace = to_chrome_trace(
-        recorder, phase_timings=phase_timings, step_seconds=step_seconds
+        recorder,
+        phase_timings=phase_timings,
+        step_seconds=step_seconds,
+        annotations=annotations,
     )
     with open(path, "w") as f:
         json.dump(trace, f)

@@ -147,6 +147,12 @@ def trace(log_dir: str):
 HBM_PEAK_BYTES_PER_SEC = 819e9
 ICI_LINK_BYTES_PER_SEC = 45e9
 ICI_LINKS_PER_CHIP = 4
+# Compute roof for the analytic roofline (telemetry/roofline.py):
+# v5e datasheet peak is 197 TFLOP/s bf16; the engines here run f32
+# elementwise/gather work on the VPU, not MXU matmuls, so the bf16
+# figure is an upper bound — using it keeps every "compute-bound"
+# verdict conservative (real programs hit the memory roof first).
+PEAK_FLOPS_PER_SEC = 197e12
 
 
 def exchange_peak_bytes_per_sec(domain: str) -> float:

@@ -1,0 +1,564 @@
+#!/usr/bin/env python
+"""Continuous attribution: knockout phase tables + XLA cost-model
+rooflines + profiler sessions, one CLI (ISSUE 14).
+
+The BENCH_CONFIGS.md CPU phase tables used to be hand-pasted knockout
+output — which is how they went stale for three PRs. This tool makes
+the committed snapshot (``telemetry/attribution_baseline.json``) the
+single source: measurement writes the snapshot, the markdown tables are
+RENDERED from it between ``<!-- attribution:* -->`` markers, and a
+structural drift gate runs in ``make check`` so "table is stale" is a
+CI failure, not a footnote.
+
+Usage:
+    python scripts/attribution.py                      # report view
+    python scripts/attribution.py --update-baseline    # re-measure
+    python scripts/attribution.py --render             # baseline -> md
+    python scripts/attribution.py --check [--format=sarif|json|github]
+    python scripts/attribution.py --update-baseline --profile DIR
+
+Modes:
+  * ``--update-baseline`` RE-MEASURES: runs the two knockout scripts
+    (``knockout_stages.py`` — the migrate step; ``knockout_pipeline.py``
+    — the two-phase pipelined engine) as subprocesses at both committed
+    shapes, computes the per-program roofline report
+    (``telemetry.roofline.roofline_report`` — compiles all registered
+    programs and cross-checks XLA's cost model against the J004/S004
+    static wire model, journaling every discrepancy), and section-merges
+    both into the snapshot. Minutes of CPU; run it when an engine's
+    phase structure or cost model changes.
+  * ``--render`` is cheap and deterministic: regenerate the
+    BENCH_CONFIGS.md tables from the committed snapshot.
+  * ``--check`` NEVER re-measures (timings are host-dependent): it
+    gates STRUCTURE — the snapshot exists, its phase names/counts match
+    the live knockout definitions, its roofline section covers every
+    progcheck-registered program, and the rendered markdown matches the
+    snapshot byte-for-byte. Exit codes mirror gridlint: 0 clean,
+    1 findings, 2 usage error.
+  * ``--profile DIR`` wraps the in-process roofline compile pass in a
+    ``telemetry.profiler.ProfilerSession`` (journaled, degrades to a
+    no-op when profiling is unavailable).
+"""
+
+import os
+import sys
+
+# the sharded registry programs need the same forced 8-device virtual
+# CPU mesh as tests/conftest.py — set BEFORE jax is imported (the
+# scripts/progcheck.py idiom)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import argparse  # noqa: E402
+import importlib.util  # noqa: E402
+import json  # noqa: E402
+import subprocess  # noqa: E402
+import tempfile  # noqa: E402
+
+from mpi_grid_redistribute_tpu.analysis.baseline import (  # noqa: E402
+    attribution_baseline_path,
+    load_attribution_baseline,
+    write_attribution_baseline,
+)
+from mpi_grid_redistribute_tpu.analysis.core import Finding  # noqa: E402
+from mpi_grid_redistribute_tpu.analysis.sarif import (  # noqa: E402
+    github_annotations,
+    to_sarif,
+)
+
+BENCH_MD = os.path.join(REPO, "BENCH_CONFIGS.md")
+GRID = "2,2,2"
+SHAPES = (4096, 65536)
+
+# the migrate knockout's cumulative truncation points (knockout_stages
+# KNOCKOUT_PHASES; diagnostics 0/41/42/71 are excluded from the
+# committed table on purpose) and their table labels
+STAGE_PHASES = (1, 2, 3, 4, 5, 6, 7, 8)
+STAGE_LABELS = {
+    1: "1 drift + wrap + bin",
+    2: "2 stable key sort + counts",
+    3: "3 local allocation fixpoint",
+    4: "4 vacated-slot plan",
+    5: "5 arrival gather",
+    6: "6 landing plan",
+    7: "7 landing (overlay)",
+    8: "8 free-stack update (**full step**)",
+}
+
+ENGINES = ("migrate", "pipeline")
+SCRIPTS = {
+    "migrate": "knockout_stages.py",
+    "pipeline": "knockout_pipeline.py",
+}
+
+RULE_DOCS = {
+    "A001": "committed attribution snapshot must exist and its phase "
+    "names/counts must match the live knockout definitions",
+    "A002": "BENCH_CONFIGS.md rendered CPU phase tables must match the "
+    "committed snapshot (run scripts/attribution.py --render)",
+    "A003": "the snapshot's roofline section must cover every "
+    "progcheck-registered program",
+}
+
+_BASELINE_REL = os.path.relpath(attribution_baseline_path(), REPO)
+
+
+def _pipeline_phases():
+    """The pipelined knockout's phase names, from the script itself so
+    this gate cannot drift from what the measurement actually cuts."""
+    spec = importlib.util.spec_from_file_location(
+        "_knockout_pipeline",
+        os.path.join(REPO, "scripts", "knockout_pipeline.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return list(mod.PHASES)
+
+
+def _live_phases(engine):
+    if engine == "migrate":
+        return list(STAGE_PHASES)
+    return _pipeline_phases()
+
+
+# ---------------------------------------------------------------------
+# measurement (--update-baseline)
+# ---------------------------------------------------------------------
+
+
+def _run_knockout(engine, n_local):
+    """One knockout subprocess -> its JSON phase rows."""
+    script = os.path.join(REPO, "scripts", SCRIPTS[engine])
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rows.json")
+        env = dict(os.environ)
+        env["KNOCKOUT_JSON"] = out
+        env["KNOCKOUT_GRID"] = GRID
+        env["JAX_PLATFORMS"] = "cpu"
+        if engine == "migrate":
+            env["KNOCKOUT_PHASES"] = ",".join(
+                str(p) for p in STAGE_PHASES
+            )
+        print(
+            f"attribution: measuring {engine} @ n_local={n_local} "
+            f"(grid {GRID}) ...",
+            file=sys.stderr,
+            flush=True,
+        )
+        proc = subprocess.run(
+            [sys.executable, script, str(n_local)],
+            cwd=REPO,
+            env=env,
+            stdout=sys.stderr,
+            stderr=sys.stderr,
+        )
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"attribution: {SCRIPTS[engine]} n_local={n_local} "
+                f"failed (exit {proc.returncode})"
+            )
+        with open(out, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+
+
+def _measure_phase_tables():
+    tables = {}
+    for engine in ENGINES:
+        shapes = {}
+        for n in SHAPES:
+            shapes[str(n)] = {"rows": _run_knockout(engine, n)}
+        tables[engine] = {
+            "grid": GRID,
+            "phases": _live_phases(engine),
+            "shapes": shapes,
+        }
+    return tables
+
+
+def _measure_roofline(profile_dir=None):
+    from mpi_grid_redistribute_tpu.telemetry.profiler import (
+        ProfilerSession,
+    )
+    from mpi_grid_redistribute_tpu.telemetry.recorder import StepRecorder
+    from mpi_grid_redistribute_tpu.telemetry.roofline import (
+        roofline_report,
+    )
+
+    rec = StepRecorder()
+    print(
+        "attribution: compiling registered programs for the cost "
+        "model ...",
+        file=sys.stderr,
+        flush=True,
+    )
+    with ProfilerSession(profile_dir, recorder=rec, label="roofline"):
+        report = roofline_report(recorder=rec)
+    n_disc = sum(1 for r in report.values() if r["discrepancy"])
+    print(
+        f"attribution: roofline over {len(report)} programs, "
+        f"{n_disc} discrepancy(ies) journaled",
+        file=sys.stderr,
+    )
+    return report
+
+
+# ---------------------------------------------------------------------
+# rendering (baseline -> BENCH_CONFIGS.md)
+# ---------------------------------------------------------------------
+
+
+def _shape_label(grid, n):
+    v = 1
+    for x in grid.split(","):
+        v *= int(x)
+    if n % 1024 == 0:
+        return f"{v}×{n // 1024}k"
+    return f"{v}×{n}"
+
+
+def _fmt_ms(seconds, bold=False):
+    s = f"{seconds * 1e3:.2f}"
+    return f"**{s}**" if bold else s
+
+
+def _fmt_delta(seconds, first):
+    if first:
+        return "(first)"
+    ms = seconds * 1e3
+    # unicode minus, matching the hand-written tables this replaces
+    return f"+{ms:.2f}" if ms >= 0 else f"−{-ms:.2f}"
+
+
+def _row_label(engine, phase, last):
+    if engine == "migrate":
+        return STAGE_LABELS.get(phase, str(phase))
+    return f"{phase} (**full**)" if last else str(phase)
+
+
+def render_table(engine, table):
+    """Deterministic markdown for one engine's committed phase table."""
+    grid = table["grid"]
+    ns = sorted(int(k) for k in table["shapes"])
+    header = "| phase (cumulative) |"
+    rule = "|---|"
+    for n in ns:
+        header += f" {_shape_label(grid, n)} ms | delta |"
+        rule += "---|---|"
+    lines = [header, rule]
+    phases = table["phases"]
+    for i, phase in enumerate(phases):
+        last = i == len(phases) - 1
+        cells = [_row_label(engine, phase, last)]
+        for n in ns:
+            rows = table["shapes"][str(n)]["rows"]
+            row = rows[i]
+            cells.append(_fmt_ms(row["cumulative_s"], bold=last))
+            cells.append(_fmt_delta(row["delta_s"], first=i == 0))
+        lines.append("| " + " | ".join(cells) + " |")
+    return "\n".join(lines)
+
+
+def _marker(engine, which):
+    return f"<!-- attribution:{engine}:{which} -->"
+
+
+def _split_markers(text, engine):
+    """(before, inside, after) of the engine's marker region, or None
+    when the markers are absent/malformed."""
+    begin, end = _marker(engine, "begin"), _marker(engine, "end")
+    i = text.find(begin)
+    j = text.find(end)
+    if i < 0 or j < 0 or j <= i:
+        return None
+    i_end = i + len(begin)
+    return text[:i_end], text[i_end:j], text[j:]
+
+
+def render_markdown(doc, text):
+    """BENCH_CONFIGS.md content with every marker region re-rendered
+    from the snapshot ``doc``; raises SystemExit on missing markers."""
+    tables = doc.get("phase_tables") or {}
+    for engine in ENGINES:
+        if engine not in tables:
+            raise SystemExit(
+                f"attribution: snapshot has no phase_tables[{engine!r}] "
+                "— run --update-baseline first"
+            )
+        parts = _split_markers(text, engine)
+        if parts is None:
+            raise SystemExit(
+                f"attribution: BENCH_CONFIGS.md is missing the "
+                f"{_marker(engine, 'begin')} / "
+                f"{_marker(engine, 'end')} markers"
+            )
+        before, _, after = parts
+        text = (
+            before + "\n" + render_table(engine, tables[engine]) + "\n"
+            + after
+        )
+    return text
+
+
+# ---------------------------------------------------------------------
+# the drift gate (--check)
+# ---------------------------------------------------------------------
+
+
+def check_findings():
+    """Structural findings against the committed snapshot. Never
+    re-measures: timings are host-dependent, structure is not."""
+    findings = []
+
+    def fail(rule, path, msg):
+        findings.append(Finding(rule, path, 1, 0, msg, "attribution"))
+
+    doc = load_attribution_baseline()
+    if doc is None:
+        fail(
+            "A001",
+            _BASELINE_REL,
+            "no committed attribution snapshot — run "
+            "scripts/attribution.py --update-baseline",
+        )
+        return findings
+
+    tables = doc.get("phase_tables") or {}
+    for engine in ENGINES:
+        table = tables.get(engine)
+        if table is None:
+            fail(
+                "A001",
+                _BASELINE_REL,
+                f"snapshot has no phase_tables[{engine!r}] section — "
+                "run --update-baseline",
+            )
+            continue
+        live = _live_phases(engine)
+        committed = table.get("phases")
+        if committed != live:
+            fail(
+                "A001",
+                _BASELINE_REL,
+                f"phase_tables[{engine!r}].phases {committed!r} != the "
+                f"live knockout definition {live!r} — the engine's "
+                "phase structure changed; run --update-baseline",
+            )
+            continue
+        for n, shape in sorted((table.get("shapes") or {}).items()):
+            got = [r.get("phase") for r in shape.get("rows", [])]
+            if got != live:
+                fail(
+                    "A001",
+                    _BASELINE_REL,
+                    f"phase_tables[{engine!r}] shape {n}: measured row "
+                    f"phases {got!r} != the live knockout definition "
+                    f"{live!r} — run --update-baseline",
+                )
+
+    # roofline coverage: every registered program, no strays. Program
+    # REGISTRATION is jax-cheap (no tracing/compiling happens here).
+    from mpi_grid_redistribute_tpu.analysis import progcheck
+
+    want = sorted(progcheck.default_programs())
+    have = sorted(doc.get("roofline") or {})
+    for name in want:
+        if name not in have:
+            fail(
+                "A003",
+                _BASELINE_REL,
+                f"registered program {name!r} missing from the "
+                "roofline section — run --update-baseline",
+            )
+    for name in have:
+        if name not in want:
+            fail(
+                "A003",
+                _BASELINE_REL,
+                f"roofline section names {name!r}, which is not a "
+                "registered program — run --update-baseline",
+            )
+
+    # rendered-markdown drift: the committed tables must be exactly
+    # what --render would produce from the committed snapshot
+    if not findings:
+        with open(BENCH_MD, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        for engine in ENGINES:
+            parts = _split_markers(text, engine)
+            if parts is None:
+                fail(
+                    "A002",
+                    "BENCH_CONFIGS.md",
+                    f"missing {_marker(engine, 'begin')} markers for "
+                    "the rendered phase table",
+                )
+                continue
+            _, inside, _ = parts
+            want_md = render_table(engine, tables[engine])
+            if inside.strip("\n") != want_md:
+                fail(
+                    "A002",
+                    "BENCH_CONFIGS.md",
+                    f"the rendered {engine} phase table is stale vs "
+                    "the committed snapshot — run "
+                    "scripts/attribution.py --render",
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def _emit(findings, fmt):
+    if fmt == "sarif":
+        print(
+            json.dumps(
+                to_sarif(findings, "attribution", RULE_DOCS), indent=2
+            )
+        )
+    elif fmt == "json":
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "message": f.message,
+                    }
+                    for f in findings
+                ],
+                indent=2,
+            )
+        )
+    elif fmt == "github":
+        for line in github_annotations(findings):
+            print(line)
+    else:
+        for f in findings:
+            print(f"{f.path}: {f.rule} {f.message}")
+        if not findings:
+            print("attribution: clean")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="attribution",
+        description="knockout phase tables + cost-model rooflines: "
+        "measure, render, and gate the committed attribution snapshot",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="re-measure (knockout subprocesses + roofline compile "
+        "pass) and rewrite the committed snapshot",
+    )
+    p.add_argument(
+        "--render",
+        action="store_true",
+        help="regenerate the BENCH_CONFIGS.md tables from the snapshot",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="structural drift gate (never re-measures)",
+    )
+    p.add_argument(
+        "--format",
+        default="text",
+        choices=("text", "json", "sarif", "github"),
+        dest="fmt",
+    )
+    p.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="wrap the roofline compile pass in a ProfilerSession "
+        "writing a jax.profiler trace into DIR",
+    )
+    args = p.parse_args(argv)
+
+    if args.update_baseline:
+        tables = _measure_phase_tables()
+        roofline = {
+            name: row
+            for name, row in _measure_roofline(args.profile).items()
+        }
+        write_attribution_baseline(
+            None, phase_tables=tables, roofline=roofline
+        )
+        print(
+            f"attribution: wrote {_BASELINE_REL} "
+            f"({len(tables)} phase tables, {len(roofline)} roofline "
+            "rows)",
+            file=sys.stderr,
+        )
+
+    if args.render:
+        doc = load_attribution_baseline()
+        if doc is None:
+            print(
+                "attribution: no snapshot to render — run "
+                "--update-baseline first",
+                file=sys.stderr,
+            )
+            return 2
+        with open(BENCH_MD, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        new = render_markdown(doc, text)
+        if new != text:
+            with open(BENCH_MD, "w", encoding="utf-8") as fh:
+                fh.write(new)
+            print(
+                "attribution: re-rendered BENCH_CONFIGS.md phase "
+                "tables",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "attribution: BENCH_CONFIGS.md already current",
+                file=sys.stderr,
+            )
+
+    if args.check:
+        findings = check_findings()
+        _emit(findings, args.fmt)
+        return 1 if findings else 0
+
+    if not (args.update_baseline or args.render):
+        # report view: the committed snapshot, human-readable
+        doc = load_attribution_baseline()
+        if doc is None:
+            print(
+                "attribution: no committed snapshot — run "
+                "--update-baseline",
+                file=sys.stderr,
+            )
+            return 2
+        from mpi_grid_redistribute_tpu.telemetry.roofline import (
+            format_roofline_table,
+        )
+
+        for engine in ENGINES:
+            table = (doc.get("phase_tables") or {}).get(engine)
+            if table:
+                print(f"## {engine} (grid {table['grid']})")
+                print(render_table(engine, table))
+                print()
+        rl = doc.get("roofline") or {}
+        if rl:
+            print("## roofline (XLA cost model vs chip roofs)")
+            print(format_roofline_table(rl))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,15 +1,17 @@
 #!/usr/bin/env python
-"""Umbrella CI gate: gridlint + progcheck + shardcheck, one SARIF file.
+"""Umbrella CI gate: gridlint + progcheck + shardcheck + attribution,
+one SARIF file.
 
 Usage:
     python scripts/check_all.py [--sarif-out PATH]
 
-Runs all three analyzers in ``--check`` mode (each in its own
+Runs all four analyzers/gates in ``--check`` mode (each in its own
 subprocess so gridlint stays jax-free and the jaxpr analyzers get the
 forced 8-device virtual CPU mesh from their wrappers), captures their
 SARIF output, and merges the runs into one document via
 ``analysis/sarif.py``'s ``merge_sarif`` — a single code-scanning
-upload for ``make check``.
+upload for ``make check``. The attribution gate is structural only
+(phase-table/roofline snapshot drift; it never re-measures).
 
 Exit codes: 0 when every tool is clean, 1 when any tool found
 something, 2 on any usage/parse error.
@@ -31,6 +33,10 @@ TOOLS = (
     ),
     ("progcheck", ["scripts/progcheck.py", "--check", "--format=sarif"]),
     ("shardcheck", ["scripts/shardcheck.py", "--check", "--format=sarif"]),
+    (
+        "attribution",
+        ["scripts/attribution.py", "--check", "--format=sarif"],
+    ),
 )
 
 

@@ -154,6 +154,13 @@ def main(argv=None) -> int:
     ap.add_argument("--step-seconds", type=float, default=None,
                     help="measured per-step seconds for the counter "
                          "track's synthetic time axis (default 1 ms)")
+    ap.add_argument("--roofline", type=str, default=None,
+                    metavar="PROGRAM",
+                    help="annotate the --phases duration lane with "
+                         "PROGRAM's committed cost-model row (flops, "
+                         "bytes, bound-by — from telemetry/"
+                         "attribution_baseline.json; see "
+                         "scripts/attribution.py)")
     ap.add_argument("--out", type=str, required=True,
                     help="output trace JSON path")
     args = ap.parse_args(argv)
@@ -170,9 +177,38 @@ def main(argv=None) -> int:
         rec = demo_recorder(steps=args.steps)
     timings = load_phases(args.phases) if args.phases else None
 
+    annotations = None
+    if args.roofline:
+        if not timings:
+            ap.error("--roofline annotates the phase lane: give --phases")
+        from mpi_grid_redistribute_tpu.analysis.baseline import (
+            load_attribution_baseline,
+        )
+
+        doc = load_attribution_baseline()
+        row = ((doc or {}).get("roofline") or {}).get(args.roofline)
+        if row is None:
+            raise SystemExit(
+                f"--roofline: program {args.roofline!r} is not in the "
+                "committed attribution snapshot — see "
+                "scripts/attribution.py --update-baseline"
+            )
+        cost = {
+            k: row.get(k)
+            for k in (
+                "flops",
+                "bytes_accessed",
+                "t_predicted_s",
+                "bound_by",
+                "bytes_ratio",
+            )
+        }
+        annotations = {str(t.phase): cost for t in timings}
+
     n_ev = traceview.write_trace(
         args.out, rec, phase_timings=timings,
         step_seconds=args.step_seconds,
+        annotations=annotations,
     )
     print(f"wrote {args.out} ({n_ev} trace events) — open at "
           f"https://ui.perfetto.dev")

@@ -1,0 +1,436 @@
+"""Roofline observatory tests (ISSUE 14).
+
+Covers the three layers of the attribution stack:
+
+* ``telemetry/roofline.py`` — the pure hand-math (``predict``,
+  ``extract_cost``, ``cross_check``) against synthetic cost dicts with
+  exact expected values, plus one real compile through
+  ``roofline_report`` so the journaling/discrepancy path is exercised
+  end to end on the CPU backend.
+* ``telemetry/profiler.py`` — the gating contract: disabled sessions
+  journal nothing and never import jax; armed sessions journal
+  ``profile_session``; a broken profiler degrades to ``armed=False``
+  with the error string instead of taking the caller down.
+* ``scripts/attribution.py`` — the committed-snapshot drift gate:
+  clean at HEAD, findings on a perturbed snapshot/rendered table, and
+  the section-merged baseline round-trip in ``analysis/baseline.py``.
+
+Satellite surfaces ride along: the ``grid_roofline_achieved_fraction``
+gauge / ``grid_profile_sessions`` counter in ``metrics.from_journal``,
+and the Perfetto phase-lane ``annotations`` merge in ``traceview``.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+from mpi_grid_redistribute_tpu.analysis.baseline import (
+    attribution_hash,
+    load_attribution_baseline,
+    write_attribution_baseline,
+)
+from mpi_grid_redistribute_tpu.telemetry import metrics, traceview
+from mpi_grid_redistribute_tpu.telemetry.phases import PhaseTiming
+from mpi_grid_redistribute_tpu.telemetry.profiler import (
+    PROFILE_DIR_ENV,
+    ProfilerSession,
+)
+from mpi_grid_redistribute_tpu.telemetry.recorder import StepRecorder
+from mpi_grid_redistribute_tpu.telemetry.roofline import (
+    BOUND_COLLECTIVE,
+    BOUND_COMPUTE,
+    BOUND_MEMORY,
+    BOUND_UNKNOWN,
+    cross_check,
+    extract_cost,
+    format_roofline_table,
+    predict,
+    roofline_report,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_attribution():
+    spec = importlib.util.spec_from_file_location(
+        "attribution_cli",
+        os.path.join(REPO_ROOT, "scripts", "attribution.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+attribution = _load_attribution()
+
+
+# ------------------------------------------------------ roofline math
+
+
+def test_extract_cost_container_variants():
+    # jax returns a 1-list of dicts on some versions, a bare dict on
+    # others; 'bytes accessed' (with a space) is XLA's key
+    assert extract_cost([{"flops": 3.0, "bytes accessed": 7.0}]) == {
+        "flops": 3.0,
+        "bytes_accessed": 7.0,
+    }
+    assert extract_cost({"flops": 3.0}) == {
+        "flops": 3.0,
+        "bytes_accessed": 0.0,
+    }
+    assert extract_cost(None) is None
+    assert extract_cost([]) is None
+    assert extract_cost("not a cost table") is None
+
+
+def test_predict_hand_math_compute_bound():
+    row = predict(
+        {"flops": 2e9, "bytes_accessed": 1e6},
+        collective_bytes=2048,
+        peak_flops_per_sec=1e12,
+        peak_bytes_per_sec=1e9,
+        collective_peak_bytes_per_sec=1e9,
+    )
+    assert row["t_compute_s"] == pytest.approx(2e-3)
+    assert row["t_memory_s"] == pytest.approx(1e-3)
+    assert row["t_collective_s"] == pytest.approx(2.048e-6)
+    assert row["t_predicted_s"] == pytest.approx(2e-3)
+    assert row["bound_by"] == BOUND_COMPUTE
+
+
+def test_predict_hand_math_memory_and_collective_bound():
+    mem = predict(
+        {"flops": 1e6, "bytes_accessed": 8e9},
+        collective_bytes=0,
+        peak_flops_per_sec=1e12,
+        peak_bytes_per_sec=1e9,
+        collective_peak_bytes_per_sec=1e9,
+    )
+    assert mem["bound_by"] == BOUND_MEMORY
+    assert mem["t_predicted_s"] == pytest.approx(8.0)
+    coll = predict(
+        {"flops": 1e6, "bytes_accessed": 1e3},
+        collective_bytes=5_000_000_000,
+        peak_flops_per_sec=1e12,
+        peak_bytes_per_sec=1e9,
+        collective_peak_bytes_per_sec=1e9,
+    )
+    assert coll["bound_by"] == BOUND_COLLECTIVE
+    assert coll["t_predicted_s"] == pytest.approx(5.0)
+
+
+def test_predict_zero_cost_ties_break_compute_and_none_is_unknown():
+    zero = predict({"flops": 0.0, "bytes_accessed": 0.0})
+    assert zero["bound_by"] == BOUND_COMPUTE
+    assert zero["t_predicted_s"] == 0.0
+    unk = predict(None, collective_bytes=4096)
+    assert unk["bound_by"] == BOUND_UNKNOWN
+    assert unk["flops"] is None
+    assert unk["t_predicted_s"] == unk["t_collective_s"] > 0
+
+
+def test_cross_check_verdicts():
+    prof = {"collective_bytes_total": 1000}
+    wire = {"per_domain": {"ici": 600}}
+    ok = cross_check({"flops": 1.0, "bytes_accessed": 4000.0}, prof, wire)
+    assert not ok["discrepancy"]
+    assert ok["bytes_ratio"] == pytest.approx(4.0)
+    assert ok["static_ici_bytes"] == 600
+
+    low = cross_check({"flops": 1.0, "bytes_accessed": 999.0}, prof, wire)
+    assert low["discrepancy"]
+    assert "below the static collective total" in low["discrepancy_reason"]
+
+    nocost = cross_check(None, prof, wire)
+    assert nocost["discrepancy"]
+    assert "no cost model" in nocost["discrepancy_reason"]
+
+    nobase = cross_check({"flops": 1.0, "bytes_accessed": 1.0}, None, None)
+    assert nobase["discrepancy"]
+    assert "J004 baseline" in nobase["discrepancy_reason"]
+
+
+class _FakeSpec:
+    """A minimal ProgramSpec stand-in: build() -> (fn, example_args)."""
+
+    def build(self):
+        import jax.numpy as jnp
+
+        return (lambda x: x * 2.0 + 1.0), (jnp.ones((8,), jnp.float32),)
+
+
+def test_roofline_report_compiles_journals_and_flags_unbaselined():
+    rec = StepRecorder()
+    report = roofline_report(
+        programs={"fake_prog": _FakeSpec()},
+        measured_s={"fake_prog": 1e-3},
+        recorder=rec,
+    )
+    row = report["fake_prog"]
+    # a program outside the J004 baseline is a journaled discrepancy,
+    # never a silent drop
+    assert row["discrepancy"]
+    assert "J004" in row["discrepancy_reason"]
+    assert row["measured_s"] == 1e-3
+    events = rec.events("roofline")
+    assert len(events) == 1
+    assert events[0].data["program"] == "fake_prog"
+    assert events[0].data["phase"] == "total"
+    assert events[0].data["discrepancy"] is True
+    # the table renderer accepts the same rows
+    table = format_roofline_table(report)
+    assert "fake_prog" in table and "DISCREPANT" in table
+
+
+# -------------------------------------------------- profiler sessions
+
+
+def test_profiler_session_disabled_is_a_true_noop(monkeypatch):
+    monkeypatch.delenv(PROFILE_DIR_ENV, raising=False)
+    rec = StepRecorder()
+    with ProfilerSession(None, recorder=rec) as s:
+        assert not s.enabled
+    assert rec.events("profile_session") == []
+
+
+def test_profiler_session_env_knob_arms_it(tmp_path, monkeypatch):
+    calls = []
+    import jax
+
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+    monkeypatch.setenv(PROFILE_DIR_ENV, str(tmp_path))
+    rec = StepRecorder()
+    with ProfilerSession(recorder=rec, label="knob") as s:
+        assert s.enabled and s.armed
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+    (ev,) = rec.events("profile_session")
+    assert ev.data["trace_dir"] == str(tmp_path)
+    assert ev.data["label"] == "knob"
+    assert ev.data["armed"] is True
+    assert ev.data["error"] is None
+    assert ev.data["duration_s"] >= 0.0
+
+
+def test_profiler_session_broken_profiler_degrades(tmp_path, monkeypatch):
+    import jax
+
+    def _boom(d):
+        raise RuntimeError("profiler says no")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", _boom)
+    rec = StepRecorder()
+    with ProfilerSession(str(tmp_path), recorder=rec):
+        pass  # must not raise
+    (ev,) = rec.events("profile_session")
+    assert ev.data["armed"] is False
+    assert "RuntimeError" in ev.data["error"]
+
+
+# ----------------------------------------------- metrics + traceview
+
+
+def test_metrics_roofline_gauge_and_profile_counter():
+    rec = StepRecorder()
+    rec.record(
+        "roofline",
+        program="p1",
+        phase="total",
+        achieved_fraction=0.25,
+        discrepancy=False,
+    )
+    rec.record(
+        "profile_session",
+        trace_dir="/tmp/x",
+        label="s",
+        duration_s=0.1,
+        armed=True,
+        error=None,
+    )
+    text = metrics.from_journal(rec).render_openmetrics()
+    assert (
+        'grid_roofline_achieved_fraction{program="p1",phase="total"} 0.25'
+        in text
+    )
+    assert "grid_profile_sessions_total 1" in text
+
+
+def test_metrics_roofline_gauge_clears_without_measurement():
+    # rows without achieved_fraction (no measurement) must not leave a
+    # stale gauge behind
+    rec = StepRecorder()
+    rec.record(
+        "roofline", program="p1", phase="total", achieved_fraction=None
+    )
+    text = metrics.from_journal(rec).render_openmetrics()
+    assert 'grid_roofline_achieved_fraction{' not in text
+
+
+def test_traceview_annotations_merge_without_overwrite():
+    rows = [
+        PhaseTiming("1", 0.001, 0.001, None, None),
+        PhaseTiming("2", 0.003, 0.002, None, None),
+    ]
+    ann = {"1": {"flops": 5.0, "bound_by": "memory", "delta_s": 999.0}}
+    doc = traceview.to_chrome_trace(phase_timings=rows, annotations=ann)
+    lane = [
+        e
+        for e in doc["traceEvents"]
+        if e.get("pid") == 1 and e.get("ph") == "X"
+    ]
+    assert len(lane) == 2
+    by_name = {e["name"]: e["args"] for e in lane}
+    assert by_name["1"]["flops"] == 5.0
+    assert by_name["1"]["bound_by"] == "memory"
+    # measured columns win over annotation keys of the same name
+    assert by_name["1"]["delta_s"] == pytest.approx(0.001)
+    assert "flops" not in by_name["2"]
+    json.dumps(doc)  # stays serializable
+
+
+# ------------------------------------- attribution snapshot + gate
+
+
+def test_attribution_baseline_round_trip_section_merge(tmp_path):
+    path = str(tmp_path / "attr.json")
+    write_attribution_baseline(path, phase_tables={"migrate": {"x": 1}})
+    write_attribution_baseline(path, roofline={"prog": {"flops": 2.0}})
+    doc = load_attribution_baseline(path)
+    # the second write merged its section without clobbering the first
+    assert doc["phase_tables"] == {"migrate": {"x": 1}}
+    assert doc["roofline"] == {"prog": {"flops": 2.0}}
+    h = attribution_hash(path)
+    assert isinstance(h, str) and len(h) == 16
+    assert attribution_hash(path) == h
+
+
+def test_render_table_deterministic_hand_math():
+    table = {
+        "grid": "2,2,2",
+        "phases": [1, 2],
+        "shapes": {
+            "4096": {
+                "rows": [
+                    {"phase": 1, "cumulative_s": 0.0011, "delta_s": 0.0011},
+                    {"phase": 2, "cumulative_s": 0.0031, "delta_s": 0.0020},
+                ]
+            }
+        },
+    }
+    md = attribution.render_table("migrate", table)
+    assert md == attribution.render_table("migrate", table)
+    lines = md.splitlines()
+    assert lines[0] == "| phase (cumulative) | 8×4k ms | delta |"
+    assert lines[2] == "| 1 drift + wrap + bin | 1.10 | (first) |"
+    # the last row is the full step: bold ms, signed delta
+    assert "**3.10**" in lines[3] and "+2.00" in lines[3]
+
+
+def test_render_markdown_replaces_marker_regions():
+    doc = {
+        "phase_tables": {
+            "migrate": {
+                "grid": "2,2,2",
+                "phases": [1],
+                "shapes": {
+                    "4096": {
+                        "rows": [
+                            {
+                                "phase": 1,
+                                "cumulative_s": 0.001,
+                                "delta_s": 0.001,
+                            }
+                        ]
+                    }
+                },
+            },
+            "pipeline": {
+                "grid": "2,2,2",
+                "phases": ["a"],
+                "shapes": {
+                    "4096": {
+                        "rows": [
+                            {
+                                "phase": "a",
+                                "cumulative_s": 0.002,
+                                "delta_s": 0.002,
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    }
+    text = (
+        "intro\n<!-- attribution:migrate:begin -->\nSTALE\n"
+        "<!-- attribution:migrate:end -->\nmiddle\n"
+        "<!-- attribution:pipeline:begin -->\nSTALE\n"
+        "<!-- attribution:pipeline:end -->\ntail\n"
+    )
+    out = attribution.render_markdown(doc, text)
+    assert "STALE" not in out
+    assert "intro" in out and "middle" in out and "tail" in out
+    # idempotent: rendering rendered text changes nothing
+    assert attribution.render_markdown(doc, out) == out
+    with pytest.raises(SystemExit):
+        attribution.render_markdown(doc, "no markers here")
+
+
+def test_attribution_check_clean_at_head():
+    # the committed snapshot + rendered BENCH_CONFIGS.md tables must be
+    # current: the same gate `make check` runs
+    assert attribution.check_findings() == []
+
+
+def test_attribution_check_fails_on_perturbed_snapshot(monkeypatch):
+    head = load_attribution_baseline()
+    assert head is not None
+
+    perturbed = copy.deepcopy(head)
+    perturbed["phase_tables"]["migrate"]["phases"] = [1, 2, 3]
+    monkeypatch.setattr(
+        attribution, "load_attribution_baseline", lambda: perturbed
+    )
+    rules = {f.rule for f in attribution.check_findings()}
+    assert "A001" in rules
+
+    # dropping a roofline row breaks registry coverage (A003)
+    perturbed2 = copy.deepcopy(head)
+    name, _ = sorted(perturbed2["roofline"].items())[0]
+    del perturbed2["roofline"][name]
+    perturbed2["roofline"]["not_a_registered_program"] = {}
+    monkeypatch.setattr(
+        attribution, "load_attribution_baseline", lambda: perturbed2
+    )
+    msgs = [f for f in attribution.check_findings() if f.rule == "A003"]
+    assert any(name in f.message for f in msgs)
+    assert any("not_a_registered_program" in f.message for f in msgs)
+
+    # restoring the real loader ("--update-baseline" undone) is clean
+    monkeypatch.undo()
+    assert attribution.check_findings() == []
+
+
+def test_attribution_check_fails_on_stale_rendered_table(
+    tmp_path, monkeypatch
+):
+    # same snapshot, stale markdown: the A002 leg alone must fire
+    with open(attribution.BENCH_MD, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    stale = str(tmp_path / "BENCH_CONFIGS.md")
+    parts = attribution._split_markers(text, "migrate")
+    assert parts is not None
+    before, _, after = parts
+    with open(stale, "w", encoding="utf-8") as fh:
+        fh.write(before + "\n| doctored | table |\n" + after)
+    monkeypatch.setattr(attribution, "BENCH_MD", stale)
+    findings = attribution.check_findings()
+    assert {f.rule for f in findings} == {"A002"}
+    assert any("migrate" in f.message for f in findings)

@@ -763,6 +763,81 @@ def test_g009_repo_gate_resident_engine_is_marked_and_clean():
     assert findings == [], findings
 
 
+# ------------------------------------------------------------------ G010
+
+
+def test_g010_fires_on_marked_fn_without_span(tmp_path):
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    # gridlint: fastpath-engine
+    def hot_no_span(x):
+        return x + 1
+
+    # gridlint: resident-path
+    def macro_no_span(pos, count):
+        return pos, count
+    """,
+        },
+        rules=["G010"],
+    )
+    assert rules_of(findings) == ["G010"], findings
+    assert len(findings) == 2
+    assert {f.symbol for f in findings} == {"hot_no_span", "macro_no_span"}
+    assert all("named_scope" in f.message for f in findings)
+
+
+def test_g010_quiet_with_span_even_in_nested_body(tmp_path):
+    # a span anywhere lexically inside the marked function counts —
+    # including inside a scan-body nested def; unmarked functions are
+    # never G010's business, and host-side span() does NOT satisfy it
+    # (it times host code, the profiler never sees it)
+    findings = lint(
+        tmp_path,
+        {
+            "mod.py": """
+    import jax
+    from jax import lax
+    from mpi_grid_redistribute_tpu.telemetry.phases import (
+        span, traced_span,
+    )
+
+    # gridlint: fastpath-engine
+    def hot_direct(x):
+        with jax.named_scope("hot"):
+            return x + 1
+
+    # gridlint: resident-path
+    def macro_nested(pos, count):
+        def body(carry, _):
+            with traced_span("svc:drift"):
+                return carry, None
+        return lax.scan(body, (pos, count), None, length=4)
+
+    def unmarked_cold(x):
+        return x - 1
+
+    # gridlint: resident-path
+    def macro_host_span_only(pos):
+        with span("host-timer"):
+            return pos
+    """,
+        },
+        rules=["G010"],
+    )
+    assert rules_of(findings) == ["G010"], findings
+    assert findings[0].symbol == "macro_host_span_only"
+
+
+def test_g010_repo_gate_marked_hot_paths_all_carry_spans():
+    # every fastpath-engine/resident-path-marked function in the
+    # package names at least one profiler scope — the knockout and
+    # ProfilerSession attribution surface has no blind spots
+    findings = run_gridlint([PACKAGE], root=REPO_ROOT, rules=["G010"])
+    assert findings == [], findings
+
+
 # ------------------------------------------------- suppressions, baseline
 
 
