@@ -3,7 +3,7 @@ PY ?= python
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
 	soak soak-smoke rebalance-smoke service-bench progcheck \
 	progcheck-baseline shardcheck shardcheck-baseline check \
-	attribution attribution-check
+	attribution attribution-check racecheck racecheck-baseline
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -85,15 +85,17 @@ service-bench:
 # gridlint: AST-based SPMD/JIT invariant checker (G001-G010), then
 # progcheck: the semantic jaxpr analyzer (J000-J004) over the REAL
 # traced programs, then shardcheck: the sharding/replication abstract
-# interpreter (S001-S004). Exit 0 = clean or fully baselined; 1 = new
-# findings or stale baseline entries; 2 = usage/parse error.
-# See mpi_grid_redistribute_tpu/analysis/.
+# interpreter (S001-S004), then racecheck: the host-thread shared-state
+# analyzer (T001-T005) over the service control plane. Exit 0 = clean
+# or fully baselined; 1 = new findings or stale baseline entries; 2 =
+# usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --check
 	$(PY) scripts/progcheck.py --check
 	$(PY) scripts/shardcheck.py --check
+	$(PY) scripts/racecheck.py --check
 
-# one-shot CI umbrella: all four analyzers/gates, SARIF runs merged
+# one-shot CI umbrella: all five analyzers/gates, SARIF runs merged
 # into a single analysis_merged.sarif for one code-scanning upload
 check:
 	$(PY) scripts/check_all.py
@@ -135,6 +137,19 @@ shardcheck:
 # re-routing of collectives across the mesh (justify the delta)
 shardcheck-baseline:
 	$(PY) scripts/shardcheck.py --update-baseline
+
+# racecheck alone: infer the host-thread topology (Thread targets +
+# HTTP handler pools), the cross-thread shared-state matrix, and gate
+# T001-T005 against analysis/racecheck_baseline.json. Pure ast — no
+# jax, nothing scanned is executed. `--list-threads` dumps the
+# inferred topology.
+racecheck:
+	$(PY) scripts/racecheck.py --check
+
+# regenerate the racecheck baseline (then hand-edit each entry's
+# justification — a bare regen is not a justification)
+racecheck-baseline:
+	$(PY) scripts/racecheck.py --write-baseline
 
 lint-json:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --format=json

@@ -63,14 +63,36 @@ host-visible surfaces (S003), and a per-axis ICI-vs-DCN wire
 attribution drift-gated against the ``wire_attribution`` section of
 the shared profile baseline (S004). J001 consumes this pass for its
 replication proof. CLI: ``python scripts/shardcheck.py --check``
-(``make shardcheck``); ``make check`` merges all three analyzers'
-SARIF runs into one file via ``scripts/check_all.py``.
+(``make shardcheck``).
+
+The fifth family is **racecheck** (``analysis/racecheck.py`` +
+``analysis/rules_thread.py``): gridlint's pure-AST twin for the HOST
+side of the service control plane. It infers the thread topology
+(``threading.Thread`` targets with daemon/joined facts, ``http.server``
+handler pools), a per-root call-graph closure, and a cross-thread
+shared-state matrix with lock-held classification from ``with <lock>:``
+scopes, then gates T-rules T001–T005 — unguarded cross-thread writes
+(T001), lock-order cycles (T002), blocking calls under a lock (T003),
+non-daemon/un-joined threads escaping ``# gridlint: service-path``
+modules (T004), and journal mutation outside the declared
+``# racecheck: recorder-writer`` thread (T005). Suppressions use
+racecheck's OWN marker (``# racecheck: disable=T00x``); grandfathered
+findings live in ``analysis/racecheck_baseline.json``. Its runtime twin
+is ``telemetry/tsan.py`` (``ThreadAccessTracer``), which audits a live
+recorder's lock discipline deterministically. CLI:
+``python scripts/racecheck.py --check`` (``make racecheck``;
+``--list-threads`` dumps the inferred topology); ``make check`` merges
+all five analyzers' SARIF runs into one file via
+``scripts/check_all.py``.
 
 progcheck and shardcheck are NOT imported here: this package root must
 stay importable without jax (gridlint and the baseline helpers run
 host-only), so pull them in explicitly via
 ``mpi_grid_redistribute_tpu.analysis.progcheck`` /
-``mpi_grid_redistribute_tpu.analysis.shardcheck``.
+``mpi_grid_redistribute_tpu.analysis.shardcheck``. racecheck
+(``mpi_grid_redistribute_tpu.analysis.racecheck``) is jax-free like
+gridlint but stays un-imported too — its rule registry only needs
+loading when the T-rules actually run.
 """
 
 from mpi_grid_redistribute_tpu.analysis.core import (

@@ -22,6 +22,7 @@ BaselineKey = Tuple[str, str, str, str]
 _BASELINE_NAME = "gridlint_baseline.json"
 _PROGPROFILE_NAME = "progprofile_baseline.json"
 _SHARDCHECK_NAME = "shardcheck_baseline.json"
+_RACECHECK_NAME = "racecheck_baseline.json"
 
 
 def default_baseline_path() -> str:
@@ -35,6 +36,17 @@ def shardcheck_baseline_path() -> str:
     shardcheck findings use the program name as the symbol)."""
     return os.path.join(
         os.path.dirname(os.path.abspath(__file__)), _SHARDCHECK_NAME
+    )
+
+
+def racecheck_baseline_path() -> str:
+    """The T001-T005 suppression baseline (same schema and matching
+    semantics as the gridlint baseline — :func:`load_baseline` /
+    :func:`write_baseline` / :func:`split_baselined` apply verbatim).
+    racecheck messages are built from line-insensitive thread-root
+    labels, so entries survive unrelated edits."""
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), _RACECHECK_NAME
     )
 
 

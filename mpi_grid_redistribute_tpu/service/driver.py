@@ -174,6 +174,9 @@ class ServiceDriver:
         self._last_dropped = 0
         self._writer: Optional[threading.Thread] = None
         self._writer_error: Optional[str] = None
+        # guards _writer_error: written by the snapshot-writer thread,
+        # read-and-cleared (exactly once) by join_snapshot_writer
+        self._writer_lock = threading.Lock()
         self._last_snapshot_path: Optional[str] = None
         # adaptive rebalancing: the current assignment-aware edges (must
         # survive engine rebuilds — a degrade that dropped them would
@@ -438,8 +441,12 @@ class ServiceDriver:
         if t is not None:
             t.join()
             self._writer = None
-        if self._writer_error is not None:
+        # swap-and-clear under the lock so the error surfaces exactly
+        # once: close() after a failed snapshot (or abandon() after
+        # close() already raised) must not re-raise the same write error
+        with self._writer_lock:
             err, self._writer_error = self._writer_error, None
+        if err is not None:
             raise RuntimeError(f"async snapshot write failed: {err}")
 
     def snapshot(self) -> str:
@@ -465,7 +472,8 @@ class ServiceDriver:
                     extra=extra,
                 )
             except Exception as e:  # surfaced by join_snapshot_writer
-                self._writer_error = f"{type(e).__name__}: {e}"
+                with self._writer_lock:
+                    self._writer_error = f"{type(e).__name__}: {e}"
 
         self.join_snapshot_writer()  # at most one write in flight
         cadence_s = float(cfg.snapshot_every) * float(self._wall_ema or 0.0)

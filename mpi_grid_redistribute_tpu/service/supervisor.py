@@ -170,6 +170,12 @@ class Supervisor:
                     "restart", action="give_up", attempt=attempt,
                     reason=reason, step=driver.step,
                 )
+                # the breaker verdict must not leave the daemon snapshot
+                # writer running behind it: the failing driver was closed
+                # or abandoned above, but a restore/teardown path that
+                # re-armed the writer would otherwise escape here
+                if driver._writer is not None:
+                    driver.abandon()
                 _, verdict = driver.healthz()
                 return SupervisorVerdict(
                     ok=False, restarts=attempt, gave_up=True,

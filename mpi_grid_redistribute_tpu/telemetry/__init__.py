@@ -134,3 +134,7 @@ from mpi_grid_redistribute_tpu.telemetry.roofline import (  # noqa: F401
 from mpi_grid_redistribute_tpu.telemetry.profiler import (  # noqa: F401
     ProfilerSession,
 )
+from mpi_grid_redistribute_tpu.telemetry.tsan import (  # noqa: F401
+    ThreadAccess,
+    ThreadAccessTracer,
+)

@@ -2,11 +2,21 @@
 
 Production systems attribute their own incidents; this is the journal the
 rest of the repo writes to. Events are plain host-side dicts — recording
-one is a deque append and NEVER syncs the device (the same contract the
-deferred overflow checks in :mod:`..api` keep), so the recorder can stay
-on in steady-state loops. The ring is bounded (default 4096 events);
-all-time per-kind counts survive eviction, so ``counts()`` is exact even
-when the ring has wrapped.
+one is a lock-guarded deque append and NEVER syncs the device (the same
+contract the deferred overflow checks in :mod:`..api` keep), so the
+recorder can stay on in steady-state loops. The ring is bounded (default
+4096 events); all-time per-kind counts survive eviction, so ``counts()``
+is exact even when the ring has wrapped.
+
+**Locking contract** (racecheck T001/T005, SCHEMA.md "Recorder
+locking"): one recorder is shared across threads — the step loop
+records while the async snapshot writer exports the journal and the
+metrics scrape path snapshots ``events()``/``counts()``. Every mutation
+(:meth:`record`, :meth:`record_at`, :meth:`clear`) and every reader of
+``_ring``/``_counts``/``_seq`` takes the internal ``_lock``; exports
+copy the retained window under the lock and do file I/O outside it
+(racecheck T003). The lock is uncontended in steady state, keeping the
+per-event cost inside the committed <=2% recorder-overhead budget.
 
 Event kinds emitted by the in-repo instruments:
 
@@ -33,6 +43,7 @@ import io
 import json
 import os
 import socket
+import threading
 import time
 from typing import Dict, List, NamedTuple, Optional
 
@@ -90,25 +101,33 @@ class StepRecorder:
         )
         self._counts: Dict[str, int] = {}
         self._seq = 0
+        # guards _ring/_counts/_seq: the step loop records while the
+        # snapshot writer exports and the scrape path reads (see the
+        # module docstring's locking contract)
+        self._lock = threading.Lock()
         self.enabled = bool(enabled)
         self.host = socket.gethostname() if host is None else str(host)
         self.pid = os.getpid() if pid is None else int(pid)
 
     @property
     def capacity(self) -> int:
-        return self._ring.maxlen
+        with self._lock:
+            return self._ring.maxlen
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     @property
     def total_recorded(self) -> int:
-        return self._seq
+        with self._lock:
+            return self._seq
 
     @property
     def evicted(self) -> int:
         """Events recorded but no longer retained (ring wrapped)."""
-        return self._seq - len(self._ring)
+        with self._lock:
+            return self._seq - len(self._ring)
 
     def record(self, kind: str, **data) -> None:
         """Append one event. Host-side only; values must already be host
@@ -116,10 +135,8 @@ class StepRecorder:
         device value at a point where syncing is acceptable, or better,
         record only host-derived control-flow facts (capacities, call
         indices, window bounds), which is what the in-repo hooks do."""
-        self._counts[kind] = self._counts.get(kind, 0) + 1
-        self._seq += 1
-        if self.enabled:
-            self._ring.append(Event(self._seq, time.time(), kind, data))
+        with self._lock:
+            self._record_locked(kind, None, data)
 
     def record_at(self, kind: str, when: Optional[float], **data) -> None:
         """:meth:`record` with an explicit wall time — the replay path.
@@ -129,15 +146,27 @@ class StepRecorder:
         happened; stamping them with *this* process's clock would destroy
         the cross-shard ordering the merge just computed. ``when=None``
         falls back to ``time.time()`` (same as :meth:`record`)."""
-        self.record(kind, **data)
-        if self.enabled and when is not None:
-            self._ring[-1] = self._ring[-1]._replace(time=float(when))
+        with self._lock:
+            self._record_locked(kind, when, data)
+
+    def _record_locked(
+        self, kind: str, when: Optional[float], data: dict
+    ) -> None:
+        # caller holds self._lock
+        self._counts[kind] = self._counts.get(kind, 0) + 1
+        self._seq += 1
+        if self.enabled:
+            t = time.time() if when is None else float(when)
+            self._ring.append(Event(self._seq, t, kind, data))
 
     def events(self, kind: Optional[str] = None) -> List[Event]:
-        """Retained events, oldest first; optionally filtered by kind."""
-        if kind is None:
-            return list(self._ring)
-        return [e for e in self._ring if e.kind == kind]
+        """Retained events, oldest first; optionally filtered by kind.
+        Returns a snapshot copied under the lock — callers iterate it
+        without racing concurrent appends."""
+        with self._lock:
+            if kind is None:
+                return list(self._ring)
+            return [e for e in self._ring if e.kind == kind]
 
     def last(self, kind: Optional[str] = None) -> Optional[Event]:
         evs = self.events(kind)
@@ -145,13 +174,15 @@ class StepRecorder:
 
     def counts(self) -> Dict[str, int]:
         """All-time events per kind (survives ring eviction)."""
-        return dict(self._counts)
+        with self._lock:
+            return dict(self._counts)
 
     def clear(self) -> None:
         """Drop retained events AND all-time counts (fresh journal)."""
-        self._ring.clear()
-        self._counts = {}
-        self._seq = 0
+        with self._lock:
+            self._ring.clear()
+            self._counts.clear()
+            self._seq = 0
 
     def to_jsonl(self, path_or_file) -> int:
         """Write retained events as JSON Lines; returns events written.

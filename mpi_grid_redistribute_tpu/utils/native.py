@@ -72,69 +72,86 @@ def _note_fallback() -> None:
     global _logged_fallback
     if os.environ.get("MPI_GRID_NO_NATIVE"):
         return  # deliberate opt-out: fallback is the requested behavior
-    if not _logged_fallback:
+    with _lock:
+        if _logged_fallback:
+            return
         _logged_fallback = True
-        _log.warning(
-            "C++ host runtime unavailable (call utils.native.build() or "
-            "set MPI_GRID_NATIVE_BUILD=1); using NumPy fallback"
-        )
+    _log.warning(
+        "C++ host runtime unavailable (call utils.native.build() or "
+        "set MPI_GRID_NATIVE_BUILD=1); using NumPy fallback"
+    )
 
 
 def _load() -> Optional[ctypes.CDLL]:
+    """Load (building on first use if opted in) the C++ library.
+
+    The module lock only guards the ``_lib``/``_tried`` handoff; the
+    slow work — filesystem probes, the opt-in g++ build subprocess,
+    ``dlopen`` — runs OUTSIDE the critical section (racecheck T003: no
+    blocking call while holding a lock). A concurrent caller that
+    arrives while the one-time probe/build is still in flight sees
+    ``_tried`` already set and takes the NumPy fallback for that call —
+    the same loud-but-safe fallback contract every entry point has."""
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("MPI_GRID_NO_NATIVE"):
-            return None
-        path = os.path.join(_native_dir(), _LIB_NAME)
-        if not os.path.exists(path) and os.environ.get(
-            "MPI_GRID_NATIVE_BUILD"
-        ):
-            build_script = os.path.join(_native_dir(), "build.sh")
-            if os.path.exists(build_script):
-                try:
-                    subprocess.run(
-                        [build_script], check=True, capture_output=True,
-                        timeout=120,
-                    )
-                except (subprocess.SubprocessError, OSError):
-                    return None
-        if not os.path.exists(path):
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
-            return None
-        if lib.grn_abi_version() != 1:
-            return None
-        lib.grn_bin.argtypes = [
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64,
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.grn_count_sort.argtypes = [
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64,
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.grn_gather_rows.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_char_p,
-        ]
+    lib = _probe_and_load()
+    with _lock:
         _lib = lib
         return _lib
+
+
+def _probe_and_load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MPI_GRID_NO_NATIVE"):
+        return None
+    path = os.path.join(_native_dir(), _LIB_NAME)
+    if not os.path.exists(path) and os.environ.get(
+        "MPI_GRID_NATIVE_BUILD"
+    ):
+        build_script = os.path.join(_native_dir(), "build.sh")
+        if os.path.exists(build_script):
+            try:
+                subprocess.run(
+                    [build_script], check=True, capture_output=True,
+                    timeout=120,
+                )
+            except (subprocess.SubprocessError, OSError):
+                return None
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    if lib.grn_abi_version() != 1:
+        return None
+    lib.grn_bin.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.grn_count_sort.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.grn_gather_rows.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+    ]
+    return lib
 
 
 def available() -> bool:

@@ -1,17 +1,18 @@
 #!/usr/bin/env python
-"""Umbrella CI gate: gridlint + progcheck + shardcheck + attribution,
-one SARIF file.
+"""Umbrella CI gate: gridlint + progcheck + shardcheck + attribution +
+racecheck, one SARIF file.
 
 Usage:
     python scripts/check_all.py [--sarif-out PATH]
 
-Runs all four analyzers/gates in ``--check`` mode (each in its own
-subprocess so gridlint stays jax-free and the jaxpr analyzers get the
-forced 8-device virtual CPU mesh from their wrappers), captures their
-SARIF output, and merges the runs into one document via
+Runs all five analyzers/gates in ``--check`` mode (each in its own
+subprocess so the pure-AST tools stay jax-free and the jaxpr analyzers
+get the forced 8-device virtual CPU mesh from their wrappers), captures
+their SARIF output, and merges the runs into one document via
 ``analysis/sarif.py``'s ``merge_sarif`` — a single code-scanning
 upload for ``make check``. The attribution gate is structural only
-(phase-table/roofline snapshot drift; it never re-measures).
+(phase-table/roofline snapshot drift; it never re-measures); racecheck
+scans the host-thread control plane (scripts/ included).
 
 Exit codes: 0 when every tool is clean, 1 when any tool found
 something, 2 on any usage/parse error.
@@ -36,6 +37,10 @@ TOOLS = (
     (
         "attribution",
         ["scripts/attribution.py", "--check", "--format=sarif"],
+    ),
+    (
+        "racecheck",
+        ["scripts/racecheck.py", "--check", "--format=sarif"],
     ),
 )
 

@@ -42,9 +42,12 @@ import argparse
 import http.server
 import json
 import os
+import signal
 import sys
 import threading
 import time
+
+# gridlint: service-path
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -54,8 +57,9 @@ OPENMETRICS_CONTENT_TYPE = (
 
 
 def journal_snapshotter(paths, align):
-    """Snapshot factory over JSONL shard files: re-reads and re-merges
-    on every call, so scrapes track a journal that is still growing."""
+    """``(snapshot, shutdown)`` over JSONL shard files: re-reads and
+    re-merges on every call, so scrapes track a journal that is still
+    growing. Nothing to stop — ``shutdown`` is a no-op."""
     from mpi_grid_redistribute_tpu import telemetry
 
     def snapshot():
@@ -63,13 +67,19 @@ def journal_snapshotter(paths, align):
         rec = merged.to_recorder(pod_steps=len(merged.shards) > 1)
         return rec
 
-    return snapshot
+    def shutdown():
+        return None
+
+    return snapshot, shutdown
 
 
 def demo_snapshotter(steps: int = 200):
-    """Run a small redistribute loop in a background thread; scrapes
-    snapshot its recorder live. Uses the numpy backend — the demo is
-    about the metrics surface, not the engines."""
+    """``(snapshot, shutdown)`` over a small redistribute loop run in a
+    background thread; scrapes snapshot its recorder live. Uses the
+    numpy backend — the demo is about the metrics surface, not the
+    engines. ``shutdown`` sets the stop event and joins the drive
+    thread, so every exit path (``--once``, Ctrl-C, SIGTERM, server
+    teardown) leaves no thread behind."""
     import numpy as np
 
     from mpi_grid_redistribute_tpu import api
@@ -81,7 +91,9 @@ def demo_snapshotter(steps: int = 200):
     rng = np.random.default_rng(0)
     stop = threading.Event()
 
-    def drive():
+    def drive():  # racecheck: recorder-writer
+        # the drive thread is the recorder's declared single writer
+        # (T005); the HTTP handlers only snapshot events()/counts()
         n = 4096
         pos = rng.random((n, 3), dtype=np.float32)
         vel = 0.1 * (rng.random((n, 3), dtype=np.float32) - 0.5)
@@ -101,7 +113,11 @@ def demo_snapshotter(steps: int = 200):
     def snapshot():
         return rd.telemetry
 
-    return snapshot
+    def shutdown():
+        stop.set()
+        t.join(timeout=10)
+
+    return snapshot, shutdown
 
 
 def make_handler(snapshot):
@@ -189,15 +205,22 @@ def main(argv=None) -> int:
     from mpi_grid_redistribute_tpu import telemetry
 
     if args.journal:
-        snapshot = journal_snapshotter(args.journal, args.align)
+        snapshot, shutdown = journal_snapshotter(args.journal, args.align)
     else:
-        snapshot = demo_snapshotter()
+        snapshot, shutdown = demo_snapshotter()
 
     if args.once:
-        rec = snapshot()
-        sys.stdout.write(telemetry.from_journal(rec).render_openmetrics())
-        verdict = telemetry.HealthMonitor(rec).evaluate(record=False)
-        print("healthz: " + json.dumps(verdict, sort_keys=True))
+        try:
+            rec = snapshot()
+            sys.stdout.write(
+                telemetry.from_journal(rec).render_openmetrics()
+            )
+            verdict = telemetry.HealthMonitor(rec).evaluate(record=False)
+            print("healthz: " + json.dumps(verdict, sort_keys=True))
+        finally:
+            # --once must not leave the demo drive thread running behind
+            # the printed scrape
+            shutdown()
         return 0
 
     server = http.server.ThreadingHTTPServer(
@@ -206,12 +229,21 @@ def main(argv=None) -> int:
     host, port = server.server_address[:2]
     print(f"serving http://{host}:{port}/metrics and /healthz "
           "(Ctrl-C to stop)", flush=True)
+
+    def _on_sigterm(signum, frame):
+        # route SIGTERM through the KeyboardInterrupt path below so the
+        # server closes and the snapshotter's stop event fires — a
+        # killed scrape server must not strand its drive thread
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         print("stopped")
     finally:
         server.server_close()
+        shutdown()
     return 0
 
 

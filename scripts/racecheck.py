@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Run racecheck, the repo's host-thread shared-state analyzer.
+
+Usage:
+    python scripts/racecheck.py [paths...] [--format=json] [--check]
+    python scripts/racecheck.py --list-rules
+    python scripts/racecheck.py --list-threads
+
+See mpi_grid_redistribute_tpu/analysis/racecheck.py for the thread
+model and mpi_grid_redistribute_tpu/analysis/rules_thread.py for the
+rule table (T001-T005). Suppressions use racecheck's own marker
+(``# racecheck: disable=T00x``); the committed baseline is
+mpi_grid_redistribute_tpu/analysis/racecheck_baseline.json. Pure-stdlib
+``ast`` work — nothing it scans is executed, no jax import.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_grid_redistribute_tpu.analysis.racecheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

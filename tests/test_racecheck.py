@@ -1,0 +1,893 @@
+"""racecheck: host-thread shared-state analyzer (T001-T005, ISSUE 15).
+
+Mirrors tests/test_gridlint.py's shape: every rule gets a minimal
+fixture pair — one that FIRES and a twin with the blessed idiom that
+stays QUIET — written under tmp_path and scanned with the real
+analyzer, plus CLI/exit-code coverage and the repo-wide gate (the tree
+at HEAD must be clean modulo the justified committed baseline).
+
+The second half exercises the runtime twin, ``telemetry/tsan.py``:
+``ThreadAccessTracer`` must stay silent across the supervised fault
+matrix and the SLO-breach scenario (the recorder lock actually guards
+every journal mutation), and must deterministically flag a recorder
+whose write path bypasses the lock — the regression the static T-rules
+can only approximate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from mpi_grid_redistribute_tpu.analysis.baseline import (
+    racecheck_baseline_path,
+)
+from mpi_grid_redistribute_tpu.analysis.racecheck import (
+    T_RULE_IDS,
+    build_model,
+    main as race_main,
+    run_racecheck,
+)
+from mpi_grid_redistribute_tpu.telemetry import (
+    StepRecorder,
+    ThreadAccessTracer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check(tmp_path, files, rules=None):
+    """Write ``files`` (name -> source) under tmp_path and scan them."""
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_racecheck([str(tmp_path)], root=str(tmp_path), rules=rules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------ T001
+
+
+_T001_FIRE = """
+    import threading
+
+    counter = 0
+
+    def w1():
+        global counter
+        counter = counter + 1
+
+    def w2():
+        global counter
+        counter = counter - 1
+
+    def main():
+        t1 = threading.Thread(target=w1, daemon=True)
+        t2 = threading.Thread(target=w2, daemon=True)
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+"""
+
+
+def test_t001_unguarded_global_write_fires(tmp_path):
+    fs = check(tmp_path, {"mod.py": _T001_FIRE}, rules=["T001"])
+    assert rules_of(fs) == ["T001"]
+    assert "counter" in fs[0].message
+    assert "no common lock" in fs[0].message
+
+
+def test_t001_common_lock_is_quiet(tmp_path):
+    quiet = _T001_FIRE.replace(
+        "global counter\n        counter = counter + 1",
+        "global counter\n        with lock:\n            "
+        "counter = counter + 1",
+    ).replace(
+        "global counter\n        counter = counter - 1",
+        "global counter\n        with lock:\n            "
+        "counter = counter - 1",
+    ).replace(
+        "counter = 0", "counter = 0\n    lock = threading.Lock()"
+    )
+    assert check(tmp_path, {"mod.py": quiet}, rules=["T001"]) == []
+
+
+def test_t001_class_field_from_two_threads(tmp_path):
+    src = """
+        import threading
+
+        class Tally:
+            def __init__(self):
+                self.total = 0
+                self.seen = []
+
+            def bump(self):
+                self.total = self.total + 1
+                self.seen.append(self.total)
+
+        box = Tally()
+
+        def w1():
+            box.bump()
+
+        def w2():
+            box.bump()
+
+        def main():
+            a = threading.Thread(target=w1, daemon=True)
+            b = threading.Thread(target=w2, daemon=True)
+            a.start()
+            b.start()
+            a.join()
+            b.join()
+    """
+    fs = check(tmp_path, {"mod.py": src}, rules=["T001"])
+    syms = {f.symbol for f in fs}
+    assert any("total" in s for s in syms)
+    # .append on a self.field is a WRITE through the mutator table
+    assert any("seen" in s for s in syms)
+
+
+def test_t001_handler_pool_alone_counts_as_cross_thread(tmp_path):
+    # a pool root (http.server handler) races against itself: a write
+    # inside its closure fires even with no second Thread anywhere
+    src = """
+        import http.server
+
+        total = 0
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                global total
+                total = total + 1
+    """
+    fs = check(tmp_path, {"srv.py": src}, rules=["T001"])
+    assert rules_of(fs) == ["T001"]
+    assert "total" in fs[0].message
+
+
+def test_t001_reads_only_never_fire(tmp_path):
+    # cross-thread READS of a config-style global are fine: T001 needs
+    # at least one non-init write
+    src = """
+        import threading
+
+        limit = 7
+
+        def w1():
+            return limit + 1
+
+        def w2():
+            return limit + 2
+
+        def main():
+            a = threading.Thread(target=w1, daemon=True)
+            b = threading.Thread(target=w2, daemon=True)
+            a.start()
+            b.start()
+            a.join()
+            b.join()
+    """
+    assert check(tmp_path, {"mod.py": src}, rules=["T001"]) == []
+
+
+def test_t001_caller_held_lock_guards_helper(tmp_path):
+    # the recorder.py idiom: the public method takes the lock, the
+    # private helper mutates. One level of caller-guard inference must
+    # keep the helper's writes guarded.
+    src = """
+        import threading
+
+        class Rec:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._n = self._n + 1
+
+        r = Rec()
+
+        def w1():
+            r.bump()
+
+        def w2():
+            r.bump()
+
+        def main():
+            a = threading.Thread(target=w1, daemon=True)
+            b = threading.Thread(target=w2, daemon=True)
+            a.start()
+            b.start()
+            a.join()
+            b.join()
+    """
+    assert check(tmp_path, {"mod.py": src}, rules=["T001"]) == []
+
+
+# ------------------------------------------------------------ T002
+
+
+_T002_FIRE = """
+    import threading
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def f1():
+        with a:
+            with b:
+                pass
+
+    def f2():
+        with b:
+            with a:
+                pass
+"""
+
+
+def test_t002_lock_order_cycle_fires(tmp_path):
+    fs = check(tmp_path, {"mod.py": _T002_FIRE}, rules=["T002"])
+    assert rules_of(fs) == ["T002"]
+    assert "cycle" in fs[0].message
+
+
+def test_t002_consistent_order_is_quiet(tmp_path):
+    quiet = _T002_FIRE.replace(
+        "with b:\n            with a:", "with a:\n            with b:"
+    )
+    assert check(tmp_path, {"mod.py": quiet}, rules=["T002"]) == []
+
+
+# ------------------------------------------------------------ T003
+
+
+def test_t003_sleep_under_lock_fires(tmp_path):
+    src = """
+        import threading
+        import time
+
+        lk = threading.Lock()
+
+        def f():
+            with lk:
+                time.sleep(0.5)
+    """
+    fs = check(tmp_path, {"mod.py": src}, rules=["T003"])
+    assert rules_of(fs) == ["T003"]
+    assert "while holding lock" in fs[0].message
+
+
+def test_t003_interprocedural_one_level(tmp_path):
+    # the blocking call hides one call deep; f holds the lock
+    src = """
+        import threading
+        import time
+
+        lk = threading.Lock()
+
+        def helper():
+            time.sleep(0.5)
+
+        def f():
+            with lk:
+                helper()
+    """
+    fs = check(tmp_path, {"mod.py": src}, rules=["T003"])
+    assert rules_of(fs) == ["T003"]
+    assert "helper" in fs[0].message
+
+
+def test_t003_copy_then_io_outside_lock_is_quiet(tmp_path):
+    # the blessed to_jsonl shape: snapshot under the lock, I/O outside
+    src = """
+        import threading
+        import time
+
+        lk = threading.Lock()
+        ring = []
+
+        def f():
+            with lk:
+                snap = list(ring)
+            time.sleep(0.5)
+            return snap
+    """
+    assert check(tmp_path, {"mod.py": src}, rules=["T003"]) == []
+
+
+def test_t003_str_join_is_not_blocking(tmp_path):
+    src = """
+        import threading
+
+        lk = threading.Lock()
+
+        def f(parts):
+            with lk:
+                return ",".join(parts)
+    """
+    assert check(tmp_path, {"mod.py": src}, rules=["T003"]) == []
+
+
+# ------------------------------------------------------------ T004
+
+
+_T004_FIRE = """
+    # gridlint: service-path
+    import threading
+
+    def work():
+        pass
+
+    def main():
+        t = threading.Thread(target=work)
+        t.start()
+"""
+
+
+def test_t004_undisciplined_thread_in_service_module(tmp_path):
+    fs = check(tmp_path, {"svc.py": _T004_FIRE}, rules=["T004"])
+    assert rules_of(fs) == ["T004"]
+    assert "service path" in fs[0].message
+
+
+def test_t004_daemon_and_joined_is_quiet(tmp_path):
+    quiet = _T004_FIRE.replace(
+        "t = threading.Thread(target=work)",
+        "t = threading.Thread(target=work, daemon=True)",
+    ).replace("t.start()", "t.start()\n        t.join()")
+    assert check(tmp_path, {"svc.py": quiet}, rules=["T004"]) == []
+
+
+def test_t004_unmarked_module_is_exempt(tmp_path):
+    unmarked = _T004_FIRE.replace(
+        "    # gridlint: service-path\n", ""
+    )
+    assert check(tmp_path, {"svc.py": unmarked}, rules=["T004"]) == []
+
+
+# ------------------------------------------------------------ T005
+
+
+_T005_FIRE = """
+    import threading
+
+    class StepRecorder:
+        def record(self, kind, **data):
+            pass
+
+    rec = StepRecorder()
+
+    def worker():
+        rec.record("step")
+
+    def main():
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        t.join()
+"""
+
+
+def test_t005_unmarked_writer_thread_fires(tmp_path):
+    fs = check(tmp_path, {"mod.py": _T005_FIRE}, rules=["T005"])
+    assert rules_of(fs) == ["T005"]
+    assert "recorder-writer" in fs[0].message
+
+
+def test_t005_marked_writer_is_quiet(tmp_path):
+    quiet = _T005_FIRE.replace(
+        "def worker():",
+        "def worker():  # racecheck: recorder-writer",
+    )
+    assert check(tmp_path, {"mod.py": quiet}, rules=["T005"]) == []
+
+
+def test_t005_fresh_local_recorder_is_exempt(tmp_path):
+    # a thread that builds its OWN recorder is single-writer by
+    # construction — no marker needed
+    src = """
+        import threading
+
+        class StepRecorder:
+            def record(self, kind, **data):
+                pass
+
+        def worker():
+            mine = StepRecorder()
+            mine.record("step")
+
+        def main():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            t.join()
+    """
+    assert check(tmp_path, {"mod.py": src}, rules=["T005"]) == []
+
+
+# ------------------------------------------------- suppression/model
+
+
+def test_same_line_suppression(tmp_path):
+    src = """
+        import threading
+        import time
+
+        lk = threading.Lock()
+
+        def f():
+            with lk:
+                time.sleep(0.5)  # racecheck: disable=T003
+    """
+    assert check(tmp_path, {"mod.py": src}, rules=["T003"]) == []
+
+
+def test_file_level_suppression(tmp_path):
+    src = "# racecheck: disable-file=T002\n" + textwrap.dedent(
+        _T002_FIRE
+    )
+    (tmp_path / "mod.py").write_text(src)
+    assert (
+        run_racecheck(
+            [str(tmp_path)], root=str(tmp_path), rules=["T002"]
+        )
+        == []
+    )
+
+
+def test_gridlint_markers_do_not_suppress_racecheck(tmp_path):
+    # racecheck has its OWN marker namespace: a gridlint disable on the
+    # same line must not silence a T-rule
+    src = """
+        import threading
+        import time
+
+        lk = threading.Lock()
+
+        def f():
+            with lk:
+                time.sleep(0.5)  # gridlint: disable=T003
+    """
+    fs = check(tmp_path, {"mod.py": src}, rules=["T003"])
+    assert rules_of(fs) == ["T003"]
+
+
+def test_rule_subset_filters(tmp_path):
+    both = textwrap.dedent(_T002_FIRE) + textwrap.dedent(
+        """
+        import time
+
+        def g():
+            with a:
+                time.sleep(0.5)
+        """
+    )
+    (tmp_path / "mod.py").write_text(both)
+    only = run_racecheck(
+        [str(tmp_path)], root=str(tmp_path), rules=["T002"]
+    )
+    assert set(rules_of(only)) == {"T002"}
+    every = run_racecheck([str(tmp_path)], root=str(tmp_path))
+    assert {"T002", "T003"} <= set(rules_of(every))
+
+
+def test_model_topology_facts(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(_T001_FIRE))
+    model = build_model([str(tmp_path)], root=str(tmp_path))
+    labels = sorted(model.root_by_label)
+    assert len(labels) == 2
+    for label in labels:
+        r = model.root_by_label[label]
+        assert r.daemon is True
+        assert r.joined is True
+        assert model.reach[label]  # closure reaches the target
+
+
+# ----------------------------------------------------------- CLI
+
+
+def _write_fixture(tmp_path, src):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(src))
+
+
+def test_cli_clean_exit_0(tmp_path, capsys):
+    _write_fixture(tmp_path, "x = 1\n")
+    rc = race_main(
+        [str(tmp_path), "--root", str(tmp_path), "--no-baseline"]
+    )
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_findings_exit_1_and_json(tmp_path, capsys):
+    _write_fixture(tmp_path, _T002_FIRE)
+    rc = race_main(
+        [
+            str(tmp_path),
+            "--root",
+            str(tmp_path),
+            "--no-baseline",
+            "--format=json",
+        ]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in doc["findings"]] == ["T002"]
+
+
+def test_cli_sarif_shape(tmp_path, capsys):
+    _write_fixture(tmp_path, _T002_FIRE)
+    rc = race_main(
+        [
+            str(tmp_path),
+            "--root",
+            str(tmp_path),
+            "--no-baseline",
+            "--format=sarif",
+        ]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "racecheck"
+    assert {r["ruleId"] for r in run["results"]} == {"T002"}
+
+
+def test_cli_unknown_rule_exit_2(tmp_path, capsys):
+    _write_fixture(tmp_path, "x = 1\n")
+    rc = race_main(
+        [str(tmp_path), "--root", str(tmp_path), "--rules", "T999"]
+    )
+    assert rc == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_list_rules(capsys):
+    assert race_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in T_RULE_IDS:
+        assert rid in out
+
+
+def test_cli_list_threads(tmp_path, capsys):
+    _write_fixture(tmp_path, _T001_FIRE)
+    rc = race_main(
+        [str(tmp_path), "--root", str(tmp_path), "--list-threads"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "thread roots:" in out
+    assert "daemon=True" in out
+    assert "cross-thread fields:" in out
+    assert "UNGUARDED" in out
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    _write_fixture(tmp_path, _T002_FIRE)
+    bl = tmp_path / "bl.json"
+    rc = race_main(
+        [
+            str(tmp_path),
+            "--root",
+            str(tmp_path),
+            "--write-baseline",
+            "--baseline",
+            str(bl),
+        ]
+    )
+    assert rc == 0
+    assert json.loads(bl.read_text())["findings"]
+    capsys.readouterr()
+    rc = race_main(
+        [
+            str(tmp_path),
+            "--root",
+            str(tmp_path),
+            "--check",
+            "--baseline",
+            str(bl),
+        ]
+    )
+    assert rc == 0
+    assert "baselined" in capsys.readouterr().out
+
+
+def test_cli_stale_baseline_fails_check(tmp_path, capsys):
+    _write_fixture(tmp_path, "x = 1\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(
+        json.dumps(
+            {
+                "comment": "test",
+                "findings": [
+                    {
+                        "rule": "T001",
+                        "path": "gone.py",
+                        "symbol": "gone.x",
+                        "message": "never matches",
+                        "justification": "stale on purpose",
+                    }
+                ],
+            }
+        )
+    )
+    rc = race_main(
+        [
+            str(tmp_path),
+            "--root",
+            str(tmp_path),
+            "--check",
+            "--baseline",
+            str(bl),
+        ]
+    )
+    assert rc == 1
+    assert "stale" in capsys.readouterr().out
+
+
+# ------------------------------------------------- repo-wide gate
+
+
+def test_repo_is_racecheck_clean():
+    # the committed tree must carry zero unjustified findings: the CI
+    # entry point itself (subprocess, like make racecheck runs it)
+    proc = subprocess.run(
+        [sys.executable, "scripts/racecheck.py", "--check"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_committed_baseline_entries_are_justified():
+    data = json.loads(
+        open(racecheck_baseline_path(), encoding="utf-8").read()
+    )
+    assert data["findings"], "baseline exists but is empty?"
+    for entry in data["findings"]:
+        assert entry.get("justification", "").strip(), entry
+        assert entry["rule"] in T_RULE_IDS
+
+
+# =================================================================
+# runtime twin: telemetry/tsan.py
+# =================================================================
+
+
+def test_tsan_clean_concurrent_run():
+    rec = StepRecorder(capacity=256)
+
+    def writer():
+        for i in range(200):
+            rec.record("step_time", step=i, seconds=0.001)
+
+    with ThreadAccessTracer(rec) as tracer:
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        # concurrent scrape path: snapshot reads under the lock
+        for _ in range(50):
+            rec.counts()
+            rec.events("step_time")
+        t.join()
+        tracer.assert_clean()
+        assert tracer.violations() == []
+        assert len(tracer.by_thread()) >= 2
+        assert tracer.accesses
+
+    # arm/disarm journaled per SCHEMA.md `thread_audit`
+    audits = rec.events("thread_audit")
+    assert [e.data["action"] for e in audits] == ["arm", "disarm"]
+    assert audits[1].data["violations"] == 0
+    assert audits[1].data["accesses"] > 0
+    assert audits[1].data["threads"] >= 2
+    # the traced run still counted every record()
+    assert rec.counts()["step_time"] == 200
+
+
+def test_tsan_detects_lockless_mutation():
+    rec = StepRecorder(capacity=8)
+    with ThreadAccessTracer(rec) as tracer:
+        rec.record("ok")  # locked: clean
+        # bypass the lock the way a regressed recorder would
+        rec._counts["x"] = rec._counts.get("x", 0) + 1
+        bad = tracer.violations()
+        assert len(bad) == 2  # the lockless read + the lockless write
+        assert {v.op for v in bad} == {"read", "write"}
+        assert all(v.field == "_counts" for v in bad)
+        with pytest.raises(AssertionError, match="unguarded"):
+            tracer.assert_clean()
+
+
+def test_tsan_attributes_violation_to_thread():
+    rec = StepRecorder(capacity=8)
+
+    def rogue():
+        rec._ring.append(None)  # no lock held
+
+    with ThreadAccessTracer(rec) as tracer:
+        t = threading.Thread(
+            target=rogue, name="rogue-writer", daemon=True
+        )
+        t.start()
+        t.join()
+        (v,) = tracer.violations()
+        assert v.thread_name == "rogue-writer"
+        assert v.field == "_ring"
+        assert v.op == "write"
+
+
+def test_tsan_catches_unlocked_record_subclass():
+    # the exact regression T005/T001 exist to prevent: a record() that
+    # skips the lock. The static rules see idioms; the tracer sees the
+    # actual interleaving surface — it must flag this deterministically,
+    # single-threaded, no lucky timing required.
+    class UnlockedRecorder(StepRecorder):
+        def record(self, kind, **data):
+            self._record_locked(kind, None, data)  # no lock!
+
+    rec = UnlockedRecorder(capacity=8)
+    with ThreadAccessTracer(rec) as tracer:
+        rec.record("step_time", seconds=0.001)
+        assert tracer.violations()
+        fields = {v.field for v in tracer.violations()}
+        assert "_counts" in fields and "_ring" in fields
+        with pytest.raises(AssertionError):
+            tracer.assert_clean()
+
+
+def test_tsan_disarm_restores_recorder():
+    rec = StepRecorder(capacity=16)
+    orig_lock = rec._lock
+    with ThreadAccessTracer(rec):
+        rec.record("a")
+        rec.record("b")
+        assert rec._lock is not orig_lock  # traced while armed
+    assert rec._lock is orig_lock
+    assert type(rec._counts) is dict
+    assert type(rec._ring).__name__ == "deque"
+    # journal state survives the copy-back
+    assert rec.counts()["a"] == 1
+    assert [e.kind for e in rec.events()][:2] == [
+        "thread_audit",
+        "a",
+    ]
+
+
+# ------------------------- tsan-instrumented service scenarios
+
+
+service = pytest.importorskip(
+    "mpi_grid_redistribute_tpu.service",
+    reason="service plane unavailable",
+)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        grid_shape=(2, 2, 2),
+        n_local=256,
+        steps=24,
+        seed=3,
+        backend="numpy",
+        snapshot_every=4,
+        snapshot_dir=str(tmp_path / "snaps"),
+    )
+    base.update(kw)
+    return service.DriverConfig(**base)
+
+
+def _supervised(tmp_path, cfg, faults, max_restarts=5, **policy_kw):
+    import dataclasses
+
+    rec = StepRecorder()
+
+    def factory(grid_shape=None):
+        c = cfg
+        if grid_shape is not None:
+            c = dataclasses.replace(c, grid_shape=tuple(grid_shape))
+        return service.ServiceDriver(c, recorder=rec, faults=faults)
+
+    sup = service.Supervisor(
+        factory,
+        policy=service.RestartPolicy(
+            max_restarts=max_restarts,
+            backoff_base_s=0.01,
+            backoff_cap_s=0.02,
+            **policy_kw,
+        ),
+        recorder=rec,
+        sleep_fn=lambda s: None,
+    )
+    return sup, rec
+
+
+@pytest.mark.parametrize("kind", [
+    "crash", "stall", "torn_snapshot", "journal_loss",
+    "fallback_flood",
+])
+def test_tsan_fault_matrix_lock_discipline(tmp_path, kind):
+    # the whole fault matrix re-run with the sanitizer armed: every
+    # journal access from the step loop, the async snapshot writer and
+    # the health scrape must hold the recorder lock
+    extra = {}
+    if kind == "crash":
+        fault = service.CrashFault(9)
+    elif kind == "stall":
+        fault = service.StallFault(7, seconds=0.5)
+        extra["watchdog_s"] = 0.2
+    elif kind == "torn_snapshot":
+        fault = service.TornSnapshotFault(snapshot_index=1)
+    elif kind == "journal_loss":
+        fault = service.JournalShardLossFault(6)
+        extra["journal_dir"] = str(tmp_path / "journal")
+    else:
+        fault = service.FallbackFloodFault(start_step=1, steps=24)
+
+    cfg = _cfg(tmp_path, **extra)
+    sup, rec = _supervised(
+        tmp_path, cfg, service.FaultPlan([fault])
+    )
+    with ThreadAccessTracer(rec) as tracer:
+        verdict = sup.run()
+        tracer.assert_clean()
+        assert tracer.accesses
+
+    assert verdict.ok is True, verdict
+    audits = rec.events("thread_audit")
+    assert [e.data["action"] for e in audits] == ["arm", "disarm"]
+    assert audits[-1].data["violations"] == 0
+
+
+def test_tsan_slo_breach_supervisor_clean(tmp_path):
+    # the busiest host-thread scenario in the suite (restart -> shrink
+    # -> elastic re-shard, snapshot writer live throughout): still zero
+    # unguarded journal accesses
+    cfg = _cfg(
+        tmp_path, steps=32, slo_latency_p99_s=0.25, slo_window=4,
+    )
+    plan = service.FaultPlan(
+        [service.LatencySpikeFault(2, seconds=1.0, spikes=6)]
+    )
+    sup, rec = _supervised(tmp_path, cfg, plan, shrink_after=2)
+    with ThreadAccessTracer(rec) as tracer:
+        verdict = sup.run()
+        tracer.assert_clean()
+
+    assert verdict.ok is True, verdict
+    assert verdict.restarts == 2
+    assert tuple(sup.driver.cfg.grid_shape) == (1, 2, 2)
+
+
+def test_supervisor_give_up_leaks_no_nondaemon_threads(tmp_path):
+    # T004's runtime counterpart: even when the supervisor gives up
+    # mid-run, no non-daemon helper thread may outlive it
+    before = {
+        t for t in threading.enumerate() if not t.daemon and t.is_alive()
+    }
+    cfg = _cfg(tmp_path, steps=12)
+    sup, rec = _supervised(
+        tmp_path,
+        cfg,
+        service.FaultPlan([service.CrashFault(None)]),
+        max_restarts=2,
+    )
+    verdict = sup.run()
+    assert verdict.gave_up is True
+    for t in threading.enumerate():
+        if t in before or not t.is_alive():
+            continue
+        assert t.daemon, f"non-daemon thread leaked: {t.name}"

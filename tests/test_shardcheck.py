@@ -778,10 +778,10 @@ def test_cli_script_entry_point():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_check_all_umbrella_merges_four_tools(tmp_path):
+def test_check_all_umbrella_merges_five_tools(tmp_path):
     """scripts/check_all.py: gridlint + progcheck + shardcheck +
-    attribution, clean at HEAD, all four SARIF runs merged into the one
-    requested file."""
+    attribution + racecheck, clean at HEAD, all five SARIF runs merged
+    into the one requested file."""
     out_path = str(tmp_path / "merged.sarif")
     proc = subprocess.run(
         [
@@ -798,5 +798,7 @@ def test_check_all_umbrella_merges_four_tools(tmp_path):
     with open(out_path) as fh:
         merged = json.load(fh)
     names = [r["tool"]["driver"]["name"] for r in merged["runs"]]
-    assert names == ["gridlint", "progcheck", "shardcheck", "attribution"]
+    assert names == [
+        "gridlint", "progcheck", "shardcheck", "attribution", "racecheck",
+    ]
     assert all(r["results"] == [] for r in merged["runs"])
