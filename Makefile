@@ -3,7 +3,8 @@ PY ?= python
 .PHONY: test lint lint-json baseline bench-check observe serve-metrics \
 	soak soak-smoke rebalance-smoke service-bench progcheck \
 	progcheck-baseline shardcheck shardcheck-baseline check \
-	attribution attribution-check racecheck racecheck-baseline
+	attribution attribution-check racecheck racecheck-baseline \
+	kernelcheck kernelcheck-baseline
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -82,21 +83,19 @@ service-bench:
 	JAX_PLATFORMS=cpu \
 		$(PY) -m mpi_grid_redistribute_tpu.bench.config10_service --gate
 
-# gridlint: AST-based SPMD/JIT invariant checker (G001-G010), then
-# progcheck: the semantic jaxpr analyzer (J000-J004) over the REAL
-# traced programs, then shardcheck: the sharding/replication abstract
-# interpreter (S001-S004), then racecheck: the host-thread shared-state
-# analyzer (T001-T005) over the service control plane. Exit 0 = clean
-# or fully baselined; 1 = new findings or stale baseline entries; 2 =
+# every analyzer family in --check text mode, driven off the single
+# ANALYZERS registry in scripts/check_all.py (gridlint G, progcheck J,
+# shardcheck S, attribution, racecheck T, kernelcheck K) — adding a
+# family is one registry row, not a Makefile edit. Exit 0 = clean or
+# fully baselined; 1 = new findings or stale baseline entries; 2 =
 # usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:
-	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --check
-	$(PY) scripts/progcheck.py --check
-	$(PY) scripts/shardcheck.py --check
-	$(PY) scripts/racecheck.py --check
+	$(PY) scripts/check_all.py --lint
 
-# one-shot CI umbrella: all five analyzers/gates, SARIF runs merged
-# into a single analysis_merged.sarif for one code-scanning upload
+# one-shot CI umbrella: the same six analyzers/gates, SARIF runs merged
+# into a single analysis_merged.sarif for one code-scanning upload.
+# Per-analyzer wall-time is printed so lint growth stays visible;
+# `--analyzers NAME[,NAME]` subsets the registry for fast local loops.
 check:
 	$(PY) scripts/check_all.py
 
@@ -150,6 +149,21 @@ racecheck:
 # justification — a bare regen is not a justification)
 racecheck-baseline:
 	$(PY) scripts/racecheck.py --write-baseline
+
+# kernelcheck alone: capture every registered Pallas kernel's
+# pallas_call anatomy via jax.eval_shape (no execution) and gate
+# K000-K004 (index-map bounds, scatter coverage/overlap, VMEM
+# footprint vs analysis/kernelcheck_baseline.json, lane tiling), then
+# run the K005 interpret-mode bit-identity backstop on CPU. The
+# ROADMAP item-3 megakernel must pass this gate (with a committed
+# footprint row) before it is ever compiled on a chip.
+kernelcheck:
+	$(PY) scripts/kernelcheck.py --check
+
+# refresh the K003 VMEM-footprint table after an INTENTIONAL blocking
+# change (justify the footprint delta in the commit message)
+kernelcheck-baseline:
+	$(PY) scripts/kernelcheck.py --update-baseline
 
 lint-json:
 	$(PY) scripts/gridlint.py mpi_grid_redistribute_tpu/ --format=json

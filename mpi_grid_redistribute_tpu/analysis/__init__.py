@@ -81,15 +81,32 @@ findings live in ``analysis/racecheck_baseline.json``. Its runtime twin
 is ``telemetry/tsan.py`` (``ThreadAccessTracer``), which audits a live
 recorder's lock discipline deterministically. CLI:
 ``python scripts/racecheck.py --check`` (``make racecheck``;
-``--list-threads`` dumps the inferred topology); ``make check`` merges
-all five analyzers' SARIF runs into one file via
-``scripts/check_all.py``.
+``--list-threads`` dumps the inferred topology).
 
-progcheck and shardcheck are NOT imported here: this package root must
-stay importable without jax (gridlint and the baseline helpers run
-host-only), so pull them in explicitly via
+The sixth family is **kernelcheck** (``analysis/kernelcheck.py`` +
+``analysis/rules_kernel.py``): G005's semantic complement for the
+Pallas kernels. Each shipped kernel has a registered case in the
+``KERNELS`` registry (the K-family's ``PROGRAMS`` analogue); a
+trace-time ``pl.pallas_call`` patch under ``jax.eval_shape`` captures
+the REAL call sites' grid/BlockSpec/scratch/alias anatomy, then
+K-rules K000–K005 gate — registry completeness (K000), index maps
+provably in bounds over the full grid (K001), scatter write
+coverage/overlap and the revisiting-output contract (K002), a
+(sublane, lane)-padded VMEM live footprint vs the ~16 MiB/core budget
+drift-gated against ``analysis/kernelcheck_baseline.json`` (K003),
+lane-tiling legality (K004), and interpret-mode bit-identity against
+each case's registered jnp/XLA reference (K005). Suppressions use
+kernelcheck's OWN marker (``# kernelcheck: disable=K00x``). CLI:
+``python scripts/kernelcheck.py --check`` (``make kernelcheck``);
+``make check`` runs the ``ANALYZERS`` registry in
+``scripts/check_all.py`` — all six analyzers, one merged SARIF file.
+
+progcheck, shardcheck and kernelcheck are NOT imported here: this
+package root must stay importable without jax (gridlint and the
+baseline helpers run host-only), so pull them in explicitly via
 ``mpi_grid_redistribute_tpu.analysis.progcheck`` /
-``mpi_grid_redistribute_tpu.analysis.shardcheck``. racecheck
+``mpi_grid_redistribute_tpu.analysis.shardcheck`` /
+``mpi_grid_redistribute_tpu.analysis.kernelcheck``. racecheck
 (``mpi_grid_redistribute_tpu.analysis.racecheck``) is jax-free like
 gridlint but stays un-imported too — its rule registry only needs
 loading when the T-rules actually run.

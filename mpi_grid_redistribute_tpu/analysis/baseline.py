@@ -244,6 +244,74 @@ def write_wire_baseline(path: Optional[str], wires: Dict[str, dict]) -> None:
     _write_profile_doc(path, doc)
 
 
+# -- kernelcheck's K003 VMEM-footprint table ---------------------------
+#
+# Its OWN file (kernelcheck_baseline.json): the footprint model is a
+# deterministic function of the captured pallas_call anatomy, so the
+# table is compared EXACTLY (rtol 0 by default) and any drift means the
+# kernel's blocking actually changed. The ROADMAP item-3 megakernel
+# must land a row here before it is ever compiled on a chip.
+
+_KERNELCHECK_NAME = "kernelcheck_baseline.json"
+
+_KERNELCHECK_COMMENT = (
+    "kernelcheck K003 baseline: per-kernel VMEM live-footprint table "
+    "from the captured pallas_call anatomy — (sublane, lane)-padded "
+    "block buffers (x2 when the index map varies over the grid: the "
+    "pipeline double-buffers) plus VMEM scratch, per site, with the "
+    "peak across sites. Deterministic, compared exactly. Refresh with "
+    "`python scripts/kernelcheck.py --update-baseline` and justify "
+    "the footprint delta in the commit message."
+)
+
+
+def kernelcheck_baseline_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), _KERNELCHECK_NAME
+    )
+
+
+def load_kernelcheck_baseline(
+    path: Optional[str] = None,
+) -> Optional[Dict[str, dict]]:
+    """name -> footprint dict from the ``footprints`` table, or
+    ``None`` when the file doesn't exist yet (kernelcheck then reports
+    every kernel as unbaselined)."""
+    path = path or kernelcheck_baseline_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            doc = json.load(fh)
+        except json.JSONDecodeError as exc:
+            raise SystemExit(
+                f"kernelcheck: malformed baseline {path}: {exc} — "
+                "regenerate with scripts/kernelcheck.py "
+                "--update-baseline"
+            )
+    footprints = doc.get("footprints") if isinstance(doc, dict) else None
+    if not isinstance(footprints, dict):
+        raise SystemExit(
+            f"kernelcheck: malformed baseline {path}: expected "
+            "{'comment': ..., 'footprints': {...}} — regenerate with "
+            "scripts/kernelcheck.py --update-baseline"
+        )
+    return footprints
+
+
+def write_kernelcheck_baseline(
+    path: Optional[str], footprints: Dict[str, dict]
+) -> None:
+    path = path or kernelcheck_baseline_path()
+    doc = {
+        "comment": _KERNELCHECK_COMMENT,
+        "footprints": {k: footprints[k] for k in sorted(footprints)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
 # ---------------------------------------------------------------------
 # attribution's phase/roofline snapshot (telemetry/attribution_baseline
 # .json). Same section-merged document discipline as the progprofile

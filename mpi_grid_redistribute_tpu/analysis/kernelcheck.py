@@ -1,0 +1,988 @@
+"""kernelcheck: semantic verifier of the repo's Pallas TPU kernels.
+
+gridlint's G005 is lexical — it can insist a ``pallas_call`` declares
+its grid and specs and that ``program_id`` flows through a bounding
+construct, but it cannot prove an index map stays in bounds, that a
+kernel's blocks fit VMEM, or that a scatter covers its output without
+racing itself. kernelcheck is the semantic half: a KERNELS registry
+(mirroring progcheck's PROGRAMS) re-runs the REAL ops-layer entry
+points at representative static shapes under a patched
+``pl.pallas_call`` that records every site's grid, BlockSpecs, scratch
+shapes, aliases and operand avals — captured via ``jax.eval_shape``,
+so nothing executes and no chip is touched — then abstractly
+interprets the capture:
+
+- **K000** — registry completeness: every registered kernel case must
+  capture at least one ``pallas_call`` on its kernel path (a case that
+  silently takes its XLA fallback guards nothing).
+- **K001** — in-bounds block addressing: every BlockSpec index map is
+  fitted to an affine model over the grid axes (origin + unit-offset
+  probes), the fit is verified at every grid point (grids at
+  representative shapes are small), and the resulting block-index
+  interval per dim must stay inside ``[0, ceil(dim / block))``.
+- **K002** — write coverage / overlap: blocked outputs must cover every
+  block slot (unless input/output-aliased — the alias pre-fills) and a
+  block revisited by several grid steps must be revisited in
+  CONSECUTIVE steps (the TPU revisiting/accumulation rule: the block
+  stays resident in VMEM between consecutive steps and flushes once).
+  Kernels tagged ``scatter=True`` are held to strict disjointness — any
+  revisit is an inter-program-instance write overlap.
+- **K003** — VMEM live footprint: dtype-aware, (sublane, lane)-padded
+  byte accounting of block buffers (x2 when the index map varies over
+  the grid — the pipeline double-buffers) plus VMEM scratch, gated
+  against the ~16 MiB/core budget (or the site's declared
+  ``vmem_limit_bytes``) and drift-gated exactly against the committed
+  ``analysis/kernelcheck_baseline.json`` footprint table, J004/S004
+  style. The ROADMAP item-3 megakernel must land a row here before it
+  is ever compiled on a chip.
+- **K004** — lane-tiling legality: a VMEM block that SPLITS an array's
+  lane dim must split at a multiple of 128, and a sublane split at the
+  dtype tile (f32 8 / bf16 16 / i8 32); 8-byte dtypes have no legal
+  tiling at all. (The in-kernel form of the planar G004 concern.)
+- **K005** — dynamic backstop: the kernel executed in interpret mode
+  must be BIT-IDENTICAL to its registered jnp/XLA reference twin;
+  kernels missing a reference are themselves findings. This is the
+  only rule that executes anything (CPU interpret mode).
+
+Suppressions use kernelcheck's own comment marker (``kernelcheck:
+disable=K00x`` on the finding's line, or the ``disable-file=`` form
+anywhere in the file) so a gridlint pragma never silences a K-rule.
+(Spelled without the leading hash here: the scanner reads THIS file
+for findings that carry the default path.) CLI: ``scripts/kernelcheck.py
+[--format=text|json|sarif|github] [--check] [--update-baseline]
+[--check-baseline]`` — exit codes mirror gridlint (0 clean, 1
+findings/drift, 2 usage error).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import dataclasses
+import functools
+import json
+import os
+import re
+import sys
+import traceback
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+K_RULE_IDS = ("K000", "K001", "K002", "K003", "K004", "K005")
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SELF_PATH = "mpi_grid_redistribute_tpu/analysis/kernelcheck.py"
+
+# kernelcheck's OWN suppression namespace: a gridlint/racecheck pragma
+# must never silence a K-rule (same isolation racecheck chose).
+_SUPPRESS_RE = re.compile(
+    r"#\s*kernelcheck:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>(?:K\d{3}|all)(?:\s*,\s*(?:K\d{3}|all))*)"
+)
+
+
+# ---------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelFinding:
+    """One K-rule violation in one registered kernel. Same surface as
+    gridlint's Finding (rule/path/symbol/message + ``baseline_key``) so
+    the shared SARIF/github formatters apply unchanged; the symbol is
+    the registered kernel name, like shardcheck's program."""
+
+    rule: str
+    kernel: str
+    message: str
+    path: str = _SELF_PATH
+    line: int = 1
+
+    @property
+    def symbol(self) -> str:
+        return self.kernel
+
+    def baseline_key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.kernel, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: <{self.kernel}>: {self.rule}: " \
+            f"{self.message}"
+
+
+# ---------------------------------------------------------------------
+# the captured pallas_call anatomy
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockRef:
+    """One buffer a captured ``pallas_call`` touches: a (possibly
+    blocked) input/output operand or a scratch allocation."""
+
+    role: str  # "in" | "out" | "scratch"
+    index: int  # position within its role
+    memory_space: str  # "vmem" | "smem" | "any" | "hbm" | "semaphore"
+    array_shape: Tuple[int, ...]  # full array (== buffer for scratch)
+    dtype: str  # numpy dtype name; "dma_sem" etc. for semaphores
+    block_shape: Optional[Tuple[int, ...]] = None
+    index_map: Optional[Callable] = None
+
+    @property
+    def label(self) -> str:
+        return f"{self.role}[{self.index}]"
+
+    @property
+    def blocked(self) -> bool:
+        return self.block_shape is not None and self.index_map is not None
+
+    @property
+    def itemsize(self) -> Optional[int]:
+        import numpy as np
+
+        try:
+            return int(np.dtype(self.dtype).itemsize)
+        except TypeError:
+            return None  # semaphore dtypes
+
+
+@dataclasses.dataclass
+class PallasSite:
+    """One captured ``pallas_call``: everything K001-K004 interpret."""
+
+    kernel: str  # registered KernelSpec name
+    fn_name: str  # python kernel function name
+    path: str  # repo-relative call-site path
+    line: int
+    grid: Tuple[int, ...]
+    ins: List[BlockRef]
+    outs: List[BlockRef]
+    scratch: List[BlockRef]
+    aliases: Dict[int, int]  # input operand index -> output index
+    vmem_limit_bytes: Optional[int]
+
+    @property
+    def refs(self) -> List[BlockRef]:
+        return list(self.ins) + list(self.outs) + list(self.scratch)
+
+
+def _space_name(ms, blocked: bool) -> str:
+    """Normalize a memory-space object to a lowercase token. A blocked
+    spec with no explicit space rides the VMEM pipeline; an unblocked
+    one stays wherever the operand lives (ANY)."""
+    if ms is None:
+        return "vmem" if blocked else "any"
+    v = getattr(ms, "value", None)
+    s = str(v if v is not None else ms).lower()
+    if "semaphore" in s:
+        return "semaphore"
+    for tok in ("vmem", "smem", "any", "hbm"):
+        if tok in s:
+            return tok
+    return s
+
+
+def _dtype_name(dt) -> str:
+    import numpy as np
+
+    try:
+        return np.dtype(dt).name
+    except TypeError:
+        return str(dt)
+
+
+def _as_tuple(x) -> tuple:
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+def _spec_refs(role: str, specs, arrays) -> List[BlockRef]:
+    """Pair BlockSpecs with their array avals into BlockRefs. A missing
+    or default spec is an unblocked ANY-space ref (pallas semantics:
+    whole operand, compiler-chosen space)."""
+    refs: List[BlockRef] = []
+    specs = list(specs)
+    for i, arr in enumerate(arrays):
+        spec = specs[i] if i < len(specs) else None
+        bshape = getattr(spec, "block_shape", None)
+        imap = getattr(spec, "index_map", None)
+        blocked = bshape is not None and imap is not None
+        refs.append(
+            BlockRef(
+                role=role,
+                index=i,
+                memory_space=_space_name(
+                    getattr(spec, "memory_space", None), blocked
+                ),
+                array_shape=tuple(int(d) for d in arr.shape),
+                dtype=_dtype_name(arr.dtype),
+                block_shape=(
+                    tuple(int(d) for d in bshape) if blocked else None
+                ),
+                index_map=imap if blocked else None,
+            )
+        )
+    return refs
+
+
+def _scratch_refs(scratch_shapes) -> List[BlockRef]:
+    refs: List[BlockRef] = []
+    for i, s in enumerate(scratch_shapes or ()):
+        shape = tuple(int(d) for d in getattr(s, "shape", ()) or ())
+        refs.append(
+            BlockRef(
+                role="scratch",
+                index=i,
+                memory_space=_space_name(
+                    getattr(s, "memory_space", None), False
+                ),
+                array_shape=shape,
+                dtype=_dtype_name(getattr(s, "dtype", "semaphore")),
+            )
+        )
+    return refs
+
+
+def _make_site(name, kernel_fn, kw, args, site_file, site_line):
+    fn = kernel_fn
+    while isinstance(fn, functools.partial):
+        fn = fn.func
+    grid = kw.get("grid")
+    in_specs = kw.get("in_specs")
+    out_specs = kw.get("out_specs")
+    gs = kw.get("grid_spec")
+    if grid is None and gs is not None:
+        grid = getattr(gs, "grid", None)
+        in_specs = in_specs or getattr(gs, "in_specs", None)
+        out_specs = out_specs or getattr(gs, "out_specs", None)
+    grid = tuple(int(g) for g in _as_tuple(grid))
+    out_shape = _as_tuple(kw.get("out_shape"))
+    cp = kw.get("compiler_params")
+    vmem_limit = getattr(cp, "vmem_limit_bytes", None)
+    path = site_file or _SELF_PATH
+    if os.path.isabs(path):
+        try:
+            path = os.path.relpath(path, _REPO_ROOT)
+        except ValueError:
+            pass
+    return PallasSite(
+        kernel=name,
+        fn_name=getattr(fn, "__name__", str(fn)),
+        path=path.replace(os.sep, "/"),
+        line=int(site_line or 1),
+        grid=grid,
+        ins=_spec_refs("in", _as_tuple(in_specs), args),
+        outs=_spec_refs("out", _as_tuple(out_specs), out_shape),
+        scratch=_scratch_refs(kw.get("scratch_shapes")),
+        aliases={
+            int(k): int(v)
+            for k, v in dict(kw.get("input_output_aliases") or {}).items()
+        },
+        vmem_limit_bytes=(
+            int(vmem_limit) if vmem_limit is not None else None
+        ),
+    )
+
+
+@contextlib.contextmanager
+def _patched_pallas_call(record):
+    """Swap ``pl.pallas_call`` for a recording wrapper. The ops modules
+    resolve ``pl.pallas_call`` at call time on the shared module object,
+    so patching the attribute intercepts every site; the wrapper
+    delegates to the real call, so captured runs behave identically."""
+    from jax.experimental import pallas as pl
+
+    real = pl.pallas_call
+
+    def wrapper(kernel, *pos, **kw):
+        inner = real(kernel, *pos, **kw)
+        stack = traceback.extract_stack()
+        site_file, site_line = None, 0
+        for fr in reversed(stack[:-1]):
+            f = fr.filename.replace(os.sep, "/")
+            if (
+                "/mpi_grid_redistribute_tpu/" in f
+                and "/analysis/" not in f
+            ):
+                site_file, site_line = fr.filename, fr.lineno
+                break
+        if site_file is None and len(stack) >= 2:
+            site_file, site_line = stack[-2].filename, stack[-2].lineno
+
+        def call(*args):
+            record(kernel, kw, args, site_file, site_line)
+            return inner(*args)
+
+        return call
+
+    pl.pallas_call = wrapper
+    try:
+        yield
+    finally:
+        pl.pallas_call = real
+
+
+# ---------------------------------------------------------------------
+# kernel registry (mirrors progcheck's PROGRAMS)
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCase:
+    """One runnable instance of a registered kernel.
+
+    ``args`` is a pytree of CONCRETE arrays; ``run(args, interpret)``
+    invokes the real ops-layer entry point. The capture pass feeds
+    ``run`` through ``jax.eval_shape`` with ``args`` abstracted, so the
+    jitted entry traces without executing; K005 calls it concretely
+    with ``interpret=True`` and bit-compares against ``reference``."""
+
+    args: Any
+    run: Callable[[Any, bool], Any]
+    reference: Optional[Callable[[Any], Any]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """One Pallas kernel kernelcheck guards, at one representative
+    static shape. ``scatter=True`` holds K002 to strict write
+    disjointness (no block revisits at all); ``capture_interpret``
+    routes the capture trace through ``interpret=True`` for entry
+    points whose kernel path is platform-gated (segdep)."""
+
+    name: str
+    build: Callable[[], KernelCase]
+    description: str = ""
+    scatter: bool = False
+    capture_interpret: bool = False
+    tags: Tuple[str, ...] = ()
+
+
+KERNELS: Dict[str, KernelSpec] = {}
+
+
+def register_kernel(spec: KernelSpec) -> KernelSpec:
+    if spec.name in KERNELS:
+        raise ValueError(f"kernel {spec.name!r} already registered")
+    KERNELS[spec.name] = spec
+    return spec
+
+
+def _run_case(case: KernelCase, interpret: bool, args):
+    return case.run(args, interpret)
+
+
+def capture_kernel(spec: KernelSpec):
+    """Build the case and capture its pallas_call sites WITHOUT
+    executing anything: ``jax.eval_shape`` abstracts ``case.args``, so
+    the jitted entry (and the pallas_call inside it) only traces."""
+    import jax
+
+    # the recording is a TRACE-TIME side effect: a jit-cached entry
+    # point would skip re-tracing on the second capture in the same
+    # process and record nothing, so drop the caches first
+    jax.clear_caches()
+    case = spec.build()
+    sites: List[PallasSite] = []
+
+    def record(kernel, kw, args, f, ln):
+        sites.append(_make_site(spec.name, kernel, kw, args, f, ln))
+
+    with _patched_pallas_call(record):
+        jax.eval_shape(
+            functools.partial(_run_case, case, spec.capture_interpret),
+            case.args,
+        )
+    return case, sites
+
+
+# -- the default registry: every Pallas kernel the ops layer ships -----
+#
+# Shapes are chosen so (a) every entry point takes its KERNEL path, not
+# the XLA fallback, (b) grids have >= 2 steps where the contract allows
+# it (a 1-step grid proves nothing about index maps), and (c) the K005
+# interpret runs stay CPU-cheap. Data is deterministic (fixed seeds).
+
+
+def _build_driftbin() -> KernelCase:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+    from mpi_grid_redistribute_tpu.ops import pallas_driftbin
+
+    V, n, w = 8, 2048, 1024
+    domain = Domain(0.0, 1.0, periodic=True)
+    grid = ProcessGrid((2, 2, 2))
+    r = np.random.default_rng(11)
+    m = V * n
+    pos = (r.random((3, m), dtype=np.float32) * 2 - 0.5).astype(np.float32)
+    vel = (r.random((3, m), dtype=np.float32) - 0.5).astype(np.float32)
+    alive = (r.random((m,)) < 0.9).astype(np.int32)
+    flat = jnp.asarray(
+        np.concatenate(
+            [pos.view(np.int32), vel.view(np.int32), alive[None, :]], axis=0
+        )
+    )
+
+    def run(args, interpret):
+        return pallas_driftbin.drift_wrap_bin(
+            args, 0.05, domain, grid, V, V, interpret=interpret, w=w
+        )
+
+    def reference(args):
+        import jax
+
+        # the twin must run UNDER JIT: LLVM contracts the drift mul+add
+        # into an fma in both jitted paths (see the kernel's FMA note)
+        return jax.jit(
+            lambda f: pallas_driftbin.drift_wrap_bin_xla(
+                f, 0.05, domain, grid, V, V
+            )
+        )(args)
+
+    del jax
+    return KernelCase(args=flat, run=run, reference=reference)
+
+
+def _build_scatter() -> KernelCase:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mpi_grid_redistribute_tpu.ops import pallas_scatter
+
+    n_rows, k, p = 2 * pallas_scatter.BLOCK, 7, 300
+    r = np.random.default_rng(12)
+    flat = r.standard_normal((n_rows, k)).astype(np.float32)
+    targets = r.choice(n_rows + 96, size=p, replace=False).astype(np.int32)
+    targets[0] = -3  # negative = drop, folded into the sentinel
+    rows = r.standard_normal((p, k)).astype(np.float32)
+    args = (jnp.asarray(flat), jnp.asarray(targets), jnp.asarray(rows))
+
+    def run(a, interpret):
+        return pallas_scatter.scatter_rows(a[0], a[1], a[2],
+                                           interpret=interpret)
+
+    def reference(a):
+        import jax
+        import jax.numpy as jnp
+
+        # the kernel's contract drops NEGATIVE targets too (jnp's
+        # mode="drop" would wrap them NumPy-style) — fold them to the
+        # high drop sentinel before the reference scatter
+        def ref(f, t, rw):
+            t = jnp.where(t < 0, jnp.int32(f.shape[0]), t)
+            return f.at[t].set(rw, mode="drop")
+
+        return jax.jit(ref)(a[0], a[1], a[2])
+
+    return KernelCase(args=args, run=run, reference=reference)
+
+
+def _mk_overlay_case(seed, k, m, p, w, encoding) -> KernelCase:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mpi_grid_redistribute_tpu.ops import pallas_overlay
+
+    r = np.random.default_rng(seed)
+    # int32 transport: raw words, the migrate engines' round-4 path —
+    # every encoding must carry arbitrary bit patterns exactly
+    flat = r.integers(-(2**31), 2**31 - 1, size=(k, m), dtype=np.int32)
+    cols = r.integers(-(2**31), 2**31 - 1, size=(k, p), dtype=np.int32)
+    targets = r.choice(m + 128, size=p, replace=False).astype(np.int32)
+    args = (jnp.asarray(flat), jnp.asarray(targets), jnp.asarray(cols))
+
+    def run(a, interpret):
+        return pallas_overlay.overlay_scatter_planar(
+            a[0], a[1], a[2], interpret=interpret, w=w, encoding=encoding
+        )
+
+    def reference(a):
+        import jax
+
+        return jax.jit(
+            lambda f, t, c: f.at[:, t].set(c, mode="drop")
+        )(a[0], a[1], a[2])
+
+    return KernelCase(args=args, run=run, reference=reference)
+
+
+def _build_overlay_int8() -> KernelCase:
+    return _mk_overlay_case(13, 7, 8192, 300, 2048, "int8")
+
+
+def _build_overlay_half() -> KernelCase:
+    return _mk_overlay_case(14, 7, 4096, 200, 1024, "half")
+
+
+def _build_dfscan() -> KernelCase:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mpi_grid_redistribute_tpu.ops import pallas_dfscan
+
+    r = np.random.default_rng(15)
+    x = jnp.asarray(r.standard_normal((300, 256)).astype(np.float32))
+
+    def run(a, interpret):
+        return pallas_dfscan.tile_df_cumsum_rows(a, interpret=interpret)
+
+    def reference(a):
+        import jax
+
+        from mpi_grid_redistribute_tpu.ops import deposit
+
+        # TwoSum is add/sub only — no mul+add to contract — but jit for
+        # symmetry with the kernel's jitted execution anyway
+        hi, lo = jax.jit(
+            functools.partial(deposit._df_cumsum, axis=1)
+        )(a)
+        rows = a.shape[0]
+        return hi[:rows], lo[:rows]
+
+    return KernelCase(args=x, run=run, reference=reference)
+
+
+def _build_segdep() -> KernelCase:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mpi_grid_redistribute_tpu.ops import pallas_segdep
+
+    n_cells, n, d = 512, 6000, 2
+    vblock = (8, 8)
+    r = np.random.default_rng(16)
+    # sorted keys + a sentinel tail = a chunk-monotone stream. rel is
+    # DYADIC (multiples of 1/4): corner weights become multiples of
+    # 1/16 and per-cell sums (~12 rows/cell) stay EXACT in f32, so the
+    # kernel's MXU accumulation order and the fallback's segment_sum
+    # order produce identical bits — the only data class where the two
+    # engines are bit-comparable (module docstring: same channel
+    # VALUES, not same sum order).
+    keys = np.sort(r.integers(0, n_cells, size=n - 200)).astype(np.int32)
+    keys = np.concatenate(
+        [keys, np.full((200,), n_cells, np.int32)]
+    )
+    rel = (r.integers(0, 32, size=(d, n)) * 0.25).astype(np.float32)
+    args = (jnp.asarray(keys), jnp.asarray(rel))
+
+    def run(a, interpret):
+        return pallas_segdep.segsum_sorted(
+            a[0], a[1], None, n_cells, vblock, interpret=interpret
+        )
+
+    def reference(a):
+        import jax
+
+        return jax.jit(
+            lambda k, rl: pallas_segdep._segsum_xla(
+                k, rl, None, n_cells, vblock, d
+            )
+        )(a[0], a[1])
+
+    return KernelCase(args=args, run=run, reference=reference)
+
+
+_DEFAULTS_BUILT = False
+
+
+def _register_defaults() -> None:
+    """Register the shipped kernels lazily so importing this module
+    never touches jax (the builders import it on demand)."""
+    global _DEFAULTS_BUILT
+    if _DEFAULTS_BUILT:
+        return
+    _DEFAULTS_BUILT = True
+    register_kernel(
+        KernelSpec(
+            "driftbin_v8_n2048",
+            _build_driftbin,
+            "fused drift+wrap+bin, [7, 16384] int32 planar state, "
+            "grid (2, 8) with the revisited key block",
+        )
+    )
+    register_kernel(
+        KernelSpec(
+            "scatter_rows_16384x7",
+            _build_scatter,
+            "streamed row-scatter overlay, [16384, 7] f32 destination, "
+            "manual HBM chunk DMAs + raised vmem_limit_bytes",
+            scatter=True,
+        )
+    )
+    register_kernel(
+        KernelSpec(
+            "overlay_int8_7x8192",
+            _build_overlay_int8,
+            "planar one-hot overlay, int8 encoding (s8xs8->s32 MXU), "
+            "[7, 8192] int32 state, w=2048",
+            scatter=True,
+        )
+    )
+    register_kernel(
+        KernelSpec(
+            "overlay_half_7x4096",
+            _build_overlay_half,
+            "planar one-hot overlay, half encoding (uint16 planes, "
+            "HIGHEST), [7, 4096] int32 state, w=1024",
+            scatter=True,
+        )
+    )
+    register_kernel(
+        KernelSpec(
+            "dfscan_300x256",
+            _build_dfscan,
+            "within-tile double-float prefix sum, [300, 256] f32 "
+            "(row-padded to 512), grid (2,)",
+        )
+    )
+    register_kernel(
+        KernelSpec(
+            "segdep_2d_6000",
+            _build_segdep,
+            "segmented CIC deposit, 6000 chunk-monotone keys into 512 "
+            "cells (2-D, unit mass), manual chunk flushes to an ANY out",
+            # the kernel path is platform-gated (TPU or interpret) —
+            # capture through the interpret branch
+            capture_interpret=True,
+        )
+    )
+
+
+def default_kernels() -> Dict[str, KernelSpec]:
+    _register_defaults()
+    return dict(KERNELS)
+
+
+# ---------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------
+
+
+def _scan_suppressions(path: str):
+    """(file-level rules, line -> rules) for one source file; missing
+    files suppress nothing."""
+    file_rules: set = set()
+    line_rules: Dict[int, set] = {}
+    abspath = (
+        path if os.path.isabs(path) else os.path.join(_REPO_ROOT, path)
+    )
+    if not os.path.exists(abspath):
+        return file_rules, line_rules
+    with open(abspath, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            if "all" in rules:
+                rules = set(K_RULE_IDS)
+            if m.group("file"):
+                file_rules |= rules
+            else:
+                line_rules.setdefault(i, set()).update(rules)
+    return file_rules, line_rules
+
+
+def _apply_suppressions(findings):
+    cache: Dict[str, tuple] = {}
+    kept: List[KernelFinding] = []
+    n_suppressed = 0
+    for f in findings:
+        if f.path not in cache:
+            cache[f.path] = _scan_suppressions(f.path)
+        file_rules, line_rules = cache[f.path]
+        if f.rule in file_rules or f.rule in line_rules.get(f.line, set()):
+            n_suppressed += 1
+        else:
+            kept.append(f)
+    return kept, n_suppressed
+
+
+# ---------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------
+
+
+def run_kernelcheck(
+    kernels: Dict[str, KernelSpec],
+    rules: Optional[Sequence[str]] = None,
+):
+    """Capture + check every kernel. Returns ``(findings, footprints,
+    n_suppressed)``; footprints (the K003 table) are computed only when
+    K003 is selected — the CALLER gates them against the committed
+    baseline so ``--update-baseline`` shares one capture pass."""
+    from mpi_grid_redistribute_tpu.analysis import rules_kernel
+
+    selected = set(rules) if rules else set(K_RULE_IDS)
+    findings: List[KernelFinding] = []
+    footprints: Dict[str, dict] = {}
+    for name in sorted(kernels):
+        spec = kernels[name]
+        try:
+            case, sites = capture_kernel(spec)
+        except Exception as exc:  # a broken case must fail loudly,
+            # not crash the whole gate past the other kernels
+            findings.append(
+                KernelFinding(
+                    "K000",
+                    name,
+                    "kernel case failed to build/trace: "
+                    f"{type(exc).__name__}: {exc}",
+                )
+            )
+            continue
+        if not sites:
+            if "K000" in selected:
+                findings.append(
+                    KernelFinding(
+                        "K000",
+                        name,
+                        "no pallas_call captured — the entry point took "
+                        "its XLA fallback at the registered shapes; fix "
+                        "the registry case so the kernel path is "
+                        "exercised",
+                    )
+                )
+            continue
+        for site in sites:
+            if "K001" in selected:
+                findings.extend(rules_kernel.check_k001(site, spec))
+            if "K002" in selected:
+                findings.extend(rules_kernel.check_k002(site, spec))
+            if "K004" in selected:
+                findings.extend(rules_kernel.check_k004(site, spec))
+        if "K003" in selected:
+            footprints[name] = rules_kernel.footprint_profile(sites)
+            findings.extend(rules_kernel.check_k003_budget(name, sites))
+        if "K005" in selected:
+            findings.extend(rules_kernel.check_k005(name, case, sites))
+    findings, n_suppressed = _apply_suppressions(findings)
+    return findings, footprints, n_suppressed
+
+
+# ---------------------------------------------------------------------
+# CLI (exit codes mirror gridlint: 0 clean, 1 findings, 2 usage)
+# ---------------------------------------------------------------------
+
+
+def _parser() -> argparse.ArgumentParser:
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        kernelcheck_baseline_path,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="kernelcheck",
+        description="Semantic Pallas-kernel verifier: captures every "
+        "registered kernel's pallas_call anatomy via a trace-time "
+        "patch and checks invariants K000-K005.",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "sarif", "github"),
+        default="text",
+        help="output format",
+    )
+    p.add_argument(
+        "--rules",
+        default=None,
+        metavar="K00x[,K00y]",
+        help="comma-separated subset of rules to run",
+    )
+    p.add_argument(
+        "--kernels",
+        default=None,
+        metavar="NAME[,NAME]",
+        help="comma-separated subset of registered kernels",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help="K003 footprint baseline (default: "
+        f"{kernelcheck_baseline_path()})",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: additionally fail on baseline entries for "
+        "unregistered kernels",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write the current VMEM footprints to the baseline's "
+        "footprints table and exit 0",
+    )
+    p.add_argument(
+        "--check-baseline",
+        action="store_true",
+        help="measurement hygiene: flag baseline entries whose kernel "
+        "is no longer registered, without tracing anything",
+    )
+    p.add_argument(
+        "--rtol",
+        type=float,
+        default=0.0,
+        help="relative tolerance for K003 numeric drift (default 0: "
+        "the footprint model is deterministic, any drift is a change)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    p.add_argument(
+        "--list-kernels",
+        action="store_true",
+        help="list registered kernels and exit",
+    )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from mpi_grid_redistribute_tpu.analysis import rules_kernel, sarif
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        kernelcheck_baseline_path,
+        load_kernelcheck_baseline,
+        write_kernelcheck_baseline,
+    )
+
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid in K_RULE_IDS:
+            print(f"{rid}  {rules_kernel.RULE_DOCS[rid]}")
+        return 0
+
+    rules: Optional[List[str]] = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in K_RULE_IDS]
+        if unknown:
+            print(
+                f"kernelcheck: unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(K_RULE_IDS)})",
+                file=sys.stderr,
+            )
+            return 2
+
+    kernels = default_kernels()
+    if args.list_kernels:
+        for name in sorted(kernels):
+            spec = kernels[name]
+            tag = " [scatter]" if spec.scatter else ""
+            print(f"{name}{tag}  {spec.description}")
+        return 0
+
+    base_path = args.baseline or kernelcheck_baseline_path()
+    if args.check_baseline:
+        baseline = load_kernelcheck_baseline(base_path)
+        if baseline is None:
+            print(
+                f"kernelcheck: no footprint baseline at {base_path} — "
+                "run scripts/kernelcheck.py --update-baseline"
+            )
+            return 1
+        stale = sorted(set(baseline) - set(kernels))
+        for name in stale:
+            print(
+                f"stale footprint baseline entry (kernel unregistered? "
+                f"remove it with --update-baseline): {name}"
+            )
+        return 1 if stale else 0
+
+    if args.kernels:
+        wanted = [k.strip() for k in args.kernels.split(",") if k.strip()]
+        unknown = [k for k in wanted if k not in kernels]
+        if unknown:
+            print(
+                f"kernelcheck: unknown kernel(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(kernels))})",
+                file=sys.stderr,
+            )
+            return 2
+        kernels = {n: kernels[n] for n in wanted}
+
+    findings, footprints, n_suppressed = run_kernelcheck(
+        kernels, rules=rules
+    )
+
+    if args.update_baseline:
+        write_kernelcheck_baseline(base_path, footprints)
+        print(
+            f"kernelcheck: wrote {len(footprints)} footprint(s) to "
+            f"{base_path}"
+        )
+        return 0
+
+    if footprints:  # K003 selected: gate against the committed table
+        baseline = load_kernelcheck_baseline(base_path)
+        findings.extend(
+            rules_kernel.compare_footprints(
+                footprints,
+                baseline,
+                rtol=args.rtol,
+                check_stale=args.check,
+                partial=args.kernels is not None,
+            )
+        )
+        findings.sort(key=lambda f: (f.rule, f.kernel, f.message))
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "suppressed": n_suppressed,
+                    "kernels": sorted(kernels),
+                    "footprints": footprints,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    elif args.format == "sarif":
+        print(
+            json.dumps(
+                sarif.to_sarif(
+                    findings, "kernelcheck", rules_kernel.RULE_DOCS
+                ),
+                indent=2,
+            )
+        )
+    elif args.format == "github":
+        for line in sarif.github_annotations(findings):
+            print(line)
+    else:
+        for f in findings:
+            print(f.render())
+        summary = (
+            f"kernelcheck: {len(findings)} finding(s) over "
+            f"{len(kernels)} kernel(s)"
+        )
+        if n_suppressed:
+            summary += f", {n_suppressed} suppressed"
+        print(summary)
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,688 @@
+"""K-rule bodies for kernelcheck: the semantic invariants every
+captured ``pallas_call`` site must satisfy.
+
+The interpretation domain is deliberately simple: index maps at the
+registered representative shapes are functions of a handful of small
+grid axes, so each map is (a) fitted to an affine model from origin +
+unit-offset probes and (b) EXHAUSTIVELY evaluated over the grid in TPU
+execution order (lexicographic, last axis fastest — the sequential
+revisiting order Mosaic pipelines). The affine form is reported in
+findings; the enumeration is the ground truth, so non-affine maps are
+still checked exactly. Registries should keep grids small — a grid too
+large to enumerate (> 2^16 steps) is itself reported rather than
+silently under-checked.
+
+The K003 footprint model charges, per site: every VMEM block buffer at
+its (sublane, lane)-padded size — x2 when its index map varies over
+the grid, because the pipeline double-buffers block fetches — plus all
+VMEM scratch (x1: scratch is allocated once, not pipelined). SMEM is
+tracked separately (its budget is tiny but distinct), ANY/HBM operands
+are free (they never enter VMEM wholesale; kernels DMA chunks into
+scratch, which IS charged), and semaphores are metadata. The budget is
+the site's declared ``compiler_params.vmem_limit_bytes`` when present,
+else the ~16 MiB/core default.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mpi_grid_redistribute_tpu.analysis.kernelcheck import (
+    BlockRef,
+    KernelFinding,
+    KernelSpec,
+    PallasSite,
+)
+
+RULE_DOCS: Dict[str, str] = {
+    "K000": "registry completeness: every registered kernel case must "
+    "build, trace, and capture at least one pallas_call on its kernel "
+    "path (a case that silently takes its XLA fallback guards nothing)",
+    "K001": "in-bounds block addressing: every BlockSpec index map, "
+    "affine-fitted and exhaustively evaluated over the grid, must keep "
+    "each block index inside [0, ceil(dim / block_dim))",
+    "K002": "output write coverage and overlap: blocked outputs must "
+    "cover every block slot (unless input/output-aliased), revisits "
+    "must be grid-consecutive (the TPU accumulation rule), and "
+    "scatter-shaped kernels must write strictly disjoint blocks",
+    "K003": "VMEM live footprint: (sublane, lane)-padded block buffers "
+    "(x2 when pipelined) + scratch must fit the declared "
+    "vmem_limit_bytes or the ~16 MiB/core default, and must match the "
+    "committed analysis/kernelcheck_baseline.json footprint exactly",
+    "K004": "lane-tiling legality: a VMEM block that splits an array "
+    "dim must split the lane dim at a multiple of 128 and the sublane "
+    "dim at the dtype tile (f32 8 / bf16 16 / int8 32); 8-byte dtypes "
+    "have no legal tiling",
+    "K005": "dynamic backstop: interpret-mode execution must be "
+    "bit-identical to the kernel's registered jnp/XLA reference twin; "
+    "kernels with no registered reference are themselves findings",
+}
+
+DEFAULT_VMEM_BUDGET = 16 * 1024 * 1024  # ~16 MiB/core (pallas guide)
+
+_SUBLANE_TILE = {4: 8, 2: 16, 1: 32}  # itemsize -> min sublane tile
+_LANE = 128
+_ENUM_CAP = 1 << 16  # max grid steps we exhaustively enumerate
+_SHOW = 4  # examples listed per finding
+
+
+# ---------------------------------------------------------------------
+# index-map interpretation
+# ---------------------------------------------------------------------
+
+
+def _eval_map(imap, pt: Tuple[int, ...]) -> Tuple[int, ...]:
+    out = imap(*pt)
+    if not isinstance(out, tuple):
+        out = (out,)
+    return tuple(int(v) for v in out)
+
+
+def _grid_steps(grid: Tuple[int, ...]) -> int:
+    total = 1
+    for g in grid:
+        total *= int(g)
+    return total
+
+
+def grid_points(grid: Tuple[int, ...]):
+    """Grid points in TPU execution order: lexicographic with the LAST
+    axis fastest (itertools.product order) — the order Mosaic steps a
+    sequential grid, hence the order block revisits see."""
+    return itertools.product(*[range(int(g)) for g in grid])
+
+
+def affine_fit(imap, grid: Tuple[int, ...]):
+    """Fit ``idx(g) = f0 + sum_ax coef[ax] * g[ax]`` from the origin
+    plus one unit offset per axis. Axes of extent <= 1 get coefficient
+    0 (unobservable). Returns ``(f0, coefs)``."""
+    nd = len(grid)
+    f0 = _eval_map(imap, (0,) * nd)
+    coefs = []
+    for ax in range(nd):
+        if grid[ax] <= 1:
+            coefs.append(tuple(0 for _ in f0))
+            continue
+        p = [0] * nd
+        p[ax] = 1
+        fi = _eval_map(imap, tuple(p))
+        if len(fi) != len(f0):
+            raise ValueError("index map output arity varies")
+        coefs.append(tuple(b - a for a, b in zip(f0, fi)))
+    return f0, coefs
+
+
+def _affine_str(f0, coefs) -> str:
+    outs = []
+    for o in range(len(f0)):
+        terms = []
+        if f0[o]:
+            terms.append(str(f0[o]))
+        for ax in range(len(coefs)):
+            c = coefs[ax][o]
+            if c == 1:
+                terms.append(f"g{ax}")
+            elif c not in (0,):
+                terms.append(f"{c}*g{ax}")
+        outs.append(" + ".join(terms) if terms else "0")
+    return "(" + ", ".join(outs) + ")"
+
+
+def map_trace(imap, grid: Tuple[int, ...]):
+    """Exhaustive ``[(point, idx), ...]`` over the grid in execution
+    order, or None when the grid exceeds the enumeration cap."""
+    if _grid_steps(grid) > _ENUM_CAP:
+        return None
+    return [(pt, _eval_map(imap, pt)) for pt in grid_points(grid)]
+
+
+def _n_blocks(ref: BlockRef) -> Tuple[int, ...]:
+    return tuple(
+        -(-int(a) // int(b))
+        for a, b in zip(ref.array_shape, ref.block_shape)
+    )
+
+
+def _map_desc(imap, grid) -> str:
+    try:
+        f0, coefs = affine_fit(imap, grid)
+    except Exception:
+        return "(non-affine)"
+    return _affine_str(f0, coefs)
+
+
+# ---------------------------------------------------------------------
+# K001 — in-bounds block addressing
+# ---------------------------------------------------------------------
+
+
+def check_k001(site: PallasSite, spec: KernelSpec) -> List[KernelFinding]:
+    findings: List[KernelFinding] = []
+    for ref in list(site.ins) + list(site.outs):
+        if not ref.blocked:
+            continue
+        try:
+            trace = map_trace(ref.index_map, site.grid)
+        except Exception as exc:
+            findings.append(
+                KernelFinding(
+                    "K001",
+                    site.kernel,
+                    f"{ref.label} index map could not be evaluated at "
+                    f"static grid points ({type(exc).__name__}: {exc}) "
+                    "— index maps must be pure functions of the grid "
+                    "axes",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+            continue
+        if trace is None:
+            findings.append(
+                KernelFinding(
+                    "K001",
+                    site.kernel,
+                    f"{ref.label}: grid {site.grid} has "
+                    f"{_grid_steps(site.grid)} steps — too many to "
+                    "enumerate; register a smaller representative shape",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+            continue
+        if not trace:  # a zero-extent grid axis: no steps, no indices
+            continue
+        arity_bad = [
+            (pt, idx)
+            for pt, idx in trace
+            if len(idx) != len(ref.block_shape)
+        ]
+        if arity_bad:
+            pt, idx = arity_bad[0]
+            findings.append(
+                KernelFinding(
+                    "K001",
+                    site.kernel,
+                    f"{ref.label} index map returns {len(idx)} indices "
+                    f"for a rank-{len(ref.block_shape)} block (at grid "
+                    f"point {pt})",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+            continue
+        limits = _n_blocks(ref)
+        for d in range(len(limits)):
+            vals = [idx[d] for _, idx in trace]
+            mn, mx = min(vals), max(vals)
+            if mn >= 0 and mx < limits[d]:
+                continue
+            bs = ref.block_shape[d]
+            findings.append(
+                KernelFinding(
+                    "K001",
+                    site.kernel,
+                    f"{ref.label} ({ref.dtype}"
+                    f"{list(ref.array_shape)}, block "
+                    f"{list(ref.block_shape)}) index map "
+                    f"{_map_desc(ref.index_map, site.grid)} leaves the "
+                    f"valid block range on dim {d}: blocks "
+                    f"[{mn}, {mx}] vs [0, {limits[d] - 1}] over grid "
+                    f"{tuple(site.grid)} — block {mx} addresses "
+                    f"elements [{mx * bs}, {(mx + 1) * bs}) of a "
+                    f"{ref.array_shape[d]}-element dim",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------
+# K002 — write coverage / overlap
+# ---------------------------------------------------------------------
+
+
+def check_k002(site: PallasSite, spec: KernelSpec) -> List[KernelFinding]:
+    findings: List[KernelFinding] = []
+    aliased_outs = set(site.aliases.values())
+    for ref in site.outs:
+        if not ref.blocked:
+            continue  # ANY-space outs are DMA-managed; K005 backstops
+        try:
+            trace = map_trace(ref.index_map, site.grid)
+        except Exception:
+            continue  # K001 already reports unevaluable maps
+        if trace is None:
+            findings.append(
+                KernelFinding(
+                    "K002",
+                    site.kernel,
+                    f"{ref.label}: grid too large to enumerate write "
+                    "coverage — register a smaller representative shape",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+            continue
+        visits: Dict[Tuple[int, ...], List[int]] = {}
+        for ordinal, (_, idx) in enumerate(trace):
+            visits.setdefault(idx, []).append(ordinal)
+        # -- coverage: every block slot written, unless the output is
+        # input/output-aliased (the alias pre-fills the buffer)
+        if ref.index not in aliased_outs:
+            nb = _n_blocks(ref)
+            total = _grid_steps(nb)
+            missing = [
+                slot
+                for slot in itertools.product(*[range(n) for n in nb])
+                if slot not in visits
+            ]
+            if missing:
+                findings.append(
+                    KernelFinding(
+                        "K002",
+                        site.kernel,
+                        f"{ref.label} write coverage gap: "
+                        f"{len(missing)} of {total} block(s) never "
+                        f"written over grid {tuple(site.grid)} (first "
+                        f"missing: {missing[:_SHOW]}) — uncovered "
+                        "output blocks are uninitialized memory; alias "
+                        "an input or cover the slot",
+                        path=site.path,
+                        line=site.line,
+                    )
+                )
+        # -- overlap / revisit legality
+        revisited = {
+            idx: ords for idx, ords in visits.items() if len(ords) > 1
+        }
+        if not revisited:
+            continue
+        if spec.scatter:
+            ex_idx = min(revisited)
+            findings.append(
+                KernelFinding(
+                    "K002",
+                    site.kernel,
+                    f"{ref.label}: inter-program-instance write "
+                    f"overlap on {len(revisited)} block(s) — e.g. "
+                    f"block {ex_idx} written by "
+                    f"{len(revisited[ex_idx])} grid steps — "
+                    "scatter-shaped kernels must write strictly "
+                    "disjoint blocks",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+            continue
+        broken = {
+            idx: ords
+            for idx, ords in revisited.items()
+            if ords != list(range(ords[0], ords[-1] + 1))
+        }
+        if broken:
+            ex_idx = min(broken)
+            findings.append(
+                KernelFinding(
+                    "K002",
+                    site.kernel,
+                    f"{ref.label}: block {ex_idx} revisited at "
+                    f"NON-consecutive grid steps (ordinals "
+                    f"{broken[ex_idx][:_SHOW + 1]}, grid "
+                    f"{tuple(site.grid)}) — the pipeline flushes the "
+                    "block between visits, so later visits clobber "
+                    f"earlier writes ({len(broken)} block(s) affected)",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------
+# K003 — VMEM live footprint
+# ---------------------------------------------------------------------
+
+
+def _rup(n: int, m: int) -> int:
+    return -(-int(n) // m) * m
+
+
+def _padded_bytes(shape: Sequence[int], itemsize: int) -> int:
+    """Bytes of one buffer at TPU layout: last dim padded to the 128
+    lane tile, second-to-last to the dtype sublane tile."""
+    shape = tuple(int(d) for d in shape)
+    if not shape:
+        return itemsize
+    if len(shape) == 1:
+        return _rup(shape[0], _LANE) * itemsize
+    head = 1
+    for d in shape[:-2]:
+        head *= d
+    tile = _SUBLANE_TILE.get(itemsize, 8)
+    return head * _rup(shape[-2], tile) * _rup(shape[-1], _LANE) * itemsize
+
+
+def _map_varies(ref: BlockRef, grid: Tuple[int, ...]) -> bool:
+    try:
+        trace = map_trace(ref.index_map, grid)
+    except Exception:
+        return True  # unevaluable: assume pipelined (conservative)
+    if trace is None:
+        try:
+            _, coefs = affine_fit(ref.index_map, grid)
+        except Exception:
+            return True
+        return any(any(c) for c in coefs)
+    return len({idx for _, idx in trace}) > 1
+
+
+def site_footprint(site: PallasSite) -> dict:
+    """The K003 byte model for one site — deterministic, so the
+    committed baseline is compared exactly (rtol 0)."""
+    block_b = scratch_b = smem_b = 0
+    for ref in list(site.ins) + list(site.outs):
+        isz = ref.itemsize
+        if isz is None:
+            continue
+        if ref.memory_space == "smem":
+            shape = ref.block_shape if ref.blocked else ref.array_shape
+            n = isz
+            for d in shape:
+                n *= int(d)
+            smem_b += n
+            continue
+        if ref.memory_space != "vmem":
+            continue  # ANY/HBM operands never enter VMEM wholesale
+        if ref.blocked:
+            per = _padded_bytes(ref.block_shape, isz)
+            bufs = 2 if _map_varies(ref, site.grid) else 1
+            block_b += bufs * per
+        else:
+            block_b += _padded_bytes(ref.array_shape, isz)
+    for ref in site.scratch:
+        isz = ref.itemsize
+        if isz is None or ref.memory_space == "semaphore":
+            continue
+        if ref.memory_space == "smem":
+            n = isz
+            for d in ref.array_shape:
+                n *= int(d)
+            smem_b += n
+        else:
+            scratch_b += _padded_bytes(ref.array_shape, isz)
+    return {
+        "path": site.path,
+        "grid": [int(g) for g in site.grid],
+        "block_bytes": block_b,
+        "scratch_bytes": scratch_b,
+        "smem_bytes": smem_b,
+        "vmem_bytes": block_b + scratch_b,
+        "budget_bytes": site.vmem_limit_bytes or DEFAULT_VMEM_BUDGET,
+    }
+
+
+def footprint_profile(sites: Sequence[PallasSite]) -> dict:
+    """The per-kernel baseline record: one row per captured site plus
+    the peak across sites (sites within one entry run sequentially)."""
+    recs = [site_footprint(s) for s in sites]
+    return {
+        "peak_vmem_bytes": max(r["vmem_bytes"] for r in recs),
+        "sites": recs,
+    }
+
+
+def check_k003_budget(
+    name: str, sites: Sequence[PallasSite]
+) -> List[KernelFinding]:
+    findings: List[KernelFinding] = []
+    for site in sites:
+        rec = site_footprint(site)
+        if rec["vmem_bytes"] <= rec["budget_bytes"]:
+            continue
+        src = (
+            "declared compiler_params vmem_limit_bytes"
+            if site.vmem_limit_bytes
+            else "default ~16 MiB/core VMEM budget"
+        )
+        findings.append(
+            KernelFinding(
+                "K003",
+                name,
+                f"VMEM live footprint {rec['vmem_bytes']:,} B (block "
+                f"buffers {rec['block_bytes']:,} + scratch "
+                f"{rec['scratch_bytes']:,}) exceeds the {src} "
+                f"({rec['budget_bytes']:,} B) — shrink the block or "
+                "raise vmem_limit_bytes deliberately",
+                path=site.path,
+                line=site.line,
+            )
+        )
+    return findings
+
+
+def _drifted(cur, base, rtol: float) -> bool:
+    if cur == base:
+        return False
+    if rtol <= 0:
+        return True
+    return abs(cur - base) / max(abs(base), 1) > rtol
+
+
+def compare_footprints(
+    current: Dict[str, dict],
+    baseline: Optional[Dict[str, dict]],
+    rtol: float = 0.0,
+    check_stale: bool = False,
+    partial: bool = False,
+) -> List[KernelFinding]:
+    """Gate the measured footprint table against the committed one —
+    the S004/compare_wire contract: missing entries, numeric drift,
+    and (in --check over the full registry) stale entries all fail."""
+    findings: List[KernelFinding] = []
+    baseline = baseline or {}
+    keys = (
+        "vmem_bytes",
+        "block_bytes",
+        "scratch_bytes",
+        "smem_bytes",
+        "budget_bytes",
+    )
+    for name in sorted(current):
+        cur = current[name]
+        if name not in baseline:
+            findings.append(
+                KernelFinding(
+                    "K003",
+                    name,
+                    "kernel has no committed footprint baseline — run "
+                    "scripts/kernelcheck.py --update-baseline and "
+                    "commit analysis/kernelcheck_baseline.json",
+                )
+            )
+            continue
+        base = baseline[name]
+        msgs: List[str] = []
+        bsites = base.get("sites", [])
+        if len(cur["sites"]) != len(bsites):
+            msgs.append(
+                f"pallas_call site count changed: {len(bsites)} -> "
+                f"{len(cur['sites'])}"
+            )
+        else:
+            for i, (c, b) in enumerate(zip(cur["sites"], bsites)):
+                if list(c.get("grid", [])) != list(b.get("grid", [])):
+                    msgs.append(
+                        f"site {i} ({c['path']}) grid changed: "
+                        f"{b.get('grid')} -> {c.get('grid')}"
+                    )
+                for key in keys:
+                    if _drifted(c.get(key, 0), b.get(key, 0), rtol):
+                        msgs.append(
+                            f"site {i} ({c['path']}) {key} drifted: "
+                            f"{b.get(key, 0):,} -> {c.get(key, 0):,}"
+                        )
+        if _drifted(
+            cur["peak_vmem_bytes"], base.get("peak_vmem_bytes", 0), rtol
+        ):
+            msgs.append(
+                "peak_vmem_bytes drifted: "
+                f"{base.get('peak_vmem_bytes', 0):,} -> "
+                f"{cur['peak_vmem_bytes']:,}"
+            )
+        for m in msgs:
+            findings.append(
+                KernelFinding(
+                    "K003",
+                    name,
+                    m + " — review the kernel change, then refresh "
+                    "with --update-baseline",
+                )
+            )
+    if check_stale and not partial:
+        for name in sorted(set(baseline) - set(current)):
+            findings.append(
+                KernelFinding(
+                    "K003",
+                    name,
+                    "stale footprint baseline entry: kernel is no "
+                    "longer registered — remove it with "
+                    "--update-baseline",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------
+# K004 — lane-tiling legality
+# ---------------------------------------------------------------------
+
+
+def check_k004(site: PallasSite, spec: KernelSpec) -> List[KernelFinding]:
+    findings: List[KernelFinding] = []
+    for ref in site.refs:
+        if ref.memory_space != "vmem":
+            continue
+        isz = ref.itemsize
+        if isz is None:
+            continue
+        if isz not in _SUBLANE_TILE:
+            findings.append(
+                KernelFinding(
+                    "K004",
+                    site.kernel,
+                    f"{ref.label}: dtype {ref.dtype} (itemsize {isz}) "
+                    "has no legal TPU VMEM tiling — only 1/2/4-byte "
+                    "dtypes tile onto the (sublane, lane) layout",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+            continue
+        if not ref.blocked or len(ref.block_shape) < 2:
+            continue  # full buffers / 1-D refs: the compiler pads
+        tile = _SUBLANE_TILE[isz]
+        lane_bs = ref.block_shape[-1]
+        sub_bs = ref.block_shape[-2]
+        # a dim is only constrained when the block SPLITS it — a
+        # full-dim block is compiler-padded, which is legal (just
+        # possibly wasteful; K003 charges the padding)
+        if lane_bs % _LANE and lane_bs < ref.array_shape[-1]:
+            findings.append(
+                KernelFinding(
+                    "K004",
+                    site.kernel,
+                    f"{ref.label} block {list(ref.block_shape)} splits "
+                    f"the {ref.array_shape[-1]}-element lane dim at "
+                    f"{lane_bs}, not a multiple of {_LANE} — lane "
+                    "splits must align to the 128-lane tile",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+        if sub_bs % tile and sub_bs < ref.array_shape[-2]:
+            findings.append(
+                KernelFinding(
+                    "K004",
+                    site.kernel,
+                    f"{ref.label} block {list(ref.block_shape)} splits "
+                    f"the {ref.array_shape[-2]}-element sublane dim at "
+                    f"{sub_bs}, not a multiple of the {ref.dtype} "
+                    f"sublane tile {tile}",
+                    path=site.path,
+                    line=site.line,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------
+# K005 — dynamic bit-identity backstop
+# ---------------------------------------------------------------------
+
+
+def _bit_compare(got, want) -> List[str]:
+    import numpy as np
+    import jax
+
+    g = jax.tree_util.tree_leaves(got)
+    w = jax.tree_util.tree_leaves(want)
+    if len(g) != len(w):
+        return [
+            f"output arity differs: kernel {len(g)} leaves vs "
+            f"reference {len(w)}"
+        ]
+    problems: List[str] = []
+    for i, (a, b) in enumerate(zip(g, w)):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            problems.append(
+                f"leaf {i}: {a.dtype}{list(a.shape)} vs reference "
+                f"{b.dtype}{list(b.shape)}"
+            )
+            continue
+        av = np.ascontiguousarray(a)
+        bv = np.ascontiguousarray(b)
+        if av.tobytes() == bv.tobytes():
+            continue
+        va = av.reshape(-1).view((np.void, av.dtype.itemsize))
+        vb = bv.reshape(-1).view((np.void, bv.dtype.itemsize))
+        n = int(np.count_nonzero(va != vb))
+        problems.append(
+            f"leaf {i} ({a.dtype}{list(a.shape)}): {n} of {a.size} "
+            "element(s) differ at the bit level"
+        )
+    return problems
+
+
+def check_k005(name: str, case, sites) -> List[KernelFinding]:
+    path = sites[0].path
+    line = sites[0].line
+    if case.reference is None:
+        return [
+            KernelFinding(
+                "K005",
+                name,
+                "no registered jnp/XLA reference twin — the "
+                "interpret-mode bit-identity backstop cannot run; add "
+                "KernelCase.reference",
+                path=path,
+                line=line,
+            )
+        ]
+    got = case.run(case.args, True)
+    want = case.reference(case.args)
+    return [
+        KernelFinding(
+            "K005",
+            name,
+            "interpret-mode kernel output is not bit-identical to the "
+            "reference twin: " + p,
+            path=path,
+            line=line,
+        )
+        for p in _bit_compare(got, want)
+    ]

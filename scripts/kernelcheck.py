@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Run kernelcheck, the semantic Pallas-kernel verifier.
+
+Usage:
+    python scripts/kernelcheck.py [--format=json|sarif|github] [--check]
+    python scripts/kernelcheck.py --update-baseline
+    python scripts/kernelcheck.py --list-rules | --list-kernels
+
+kernelcheck re-runs the REAL ops-layer Pallas entry points at
+registered representative shapes under a patched ``pl.pallas_call``
+that records every site's grid, BlockSpecs, scratch and aliases — via
+``jax.eval_shape``, so K001-K004 never execute anything — then gates
+index-map bounds, scatter write coverage/overlap, the VMEM footprint
+against ``analysis/kernelcheck_baseline.json``, and lane-tiling
+legality. K005 additionally EXECUTES each kernel in interpret mode on
+CPU and bit-compares it against its registered jnp/XLA reference twin,
+so this wrapper pins ``JAX_PLATFORMS=cpu`` before jax is imported:
+``make kernelcheck`` behaves identically on a TPU host and in CI.
+
+Exit codes mirror gridlint: 0 clean, 1 findings/drift, 2 usage error.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_grid_redistribute_tpu.analysis.kernelcheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

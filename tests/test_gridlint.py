@@ -372,6 +372,106 @@ def test_g005_quiet_on_bounded_partial_wrapped_kernel(tmp_path):
     assert findings == [], findings
 
 
+def test_g005_quiet_on_scratch_shapes_kernel(tmp_path):
+    """scratch_shapes (VMEM accumulators + DMA semaphores) are extra
+    positional refs AFTER the in/out refs — the declared-specs and
+    bounded-program_id checks must not trip over them."""
+    findings = lint(
+        tmp_path,
+        {
+            "pallas_fix.py": """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _kernel(in_ref, out_ref, acc_ref, sem):
+        b = pl.program_id(0)
+        nb = pl.num_programs(0)
+        i = jnp.minimum(b, nb - 1)
+        acc_ref[:] = in_ref[:] * 2.0
+        out_ref[:] = acc_ref[:] + i
+
+    def launch(x, grid, in_specs, out_specs):
+        return pl.pallas_call(
+            _kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=x,
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        )(x)
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+def test_g005_quiet_on_grid_dim_zero_literal(tmp_path):
+    """A zero-extent grid dim is lexically a fully-declared launch —
+    G005 has nothing to say. Whether running ZERO instances leaves the
+    output uncovered is a semantic question: kernelcheck's K002
+    coverage rule owns it (see test_kernelcheck.py's twin)."""
+    findings = lint(
+        tmp_path,
+        {
+            "pallas_fix.py": """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _kernel(in_ref, out_ref):
+        out_ref[:] = in_ref[:]
+
+    def launch(x, nblk):
+        return pl.pallas_call(
+            _kernel,
+            grid=(nblk, 0),
+            in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=x,
+        )(x)
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
+def test_g005_quiet_on_semantically_out_of_bounds_index_map(tmp_path):
+    """The AST/semantic split, spiked from the gridlint side: this
+    launch is lexically impeccable (grid, specs, no raw program_id in
+    the kernel body) yet its index map addresses one block PAST the
+    end. G005 must stay quiet — kernelcheck K001 proves the bounds
+    violation on the captured site (the disjoint twin lives in
+    test_kernelcheck.py::test_k001_and_g005_are_disjoint)."""
+    findings = lint(
+        tmp_path,
+        {
+            "pallas_fix.py": """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _kernel(in_ref, out_ref):
+        out_ref[:] = in_ref[:] + 1.0
+
+    def launch(x):
+        return pl.pallas_call(
+            _kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i + 1, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=x,
+        )(x)
+    """,
+        },
+    )
+    assert findings == [], findings
+
+
 # ---------------------------------------------------------------- G006
 
 

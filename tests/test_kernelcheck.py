@@ -1,0 +1,657 @@
+"""kernelcheck (analysis/kernelcheck.py + rules_kernel.py) — spiked
+fixtures per K-rule, the capture machinery over the real registry, the
+CLI/baseline contract, and the repo gate.
+
+Spiked kernels are REAL ``pallas_call`` launches captured through the
+same ``jax.eval_shape`` patch the production registry uses, so the
+fixtures exercise the whole pipeline, not hand-mocked sites; rule
+corner cases that don't need capture use hand-built PallasSites."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_grid_redistribute_tpu.analysis import kernelcheck as kc
+from mpi_grid_redistribute_tpu.analysis import rules_kernel as rk
+from mpi_grid_redistribute_tpu.analysis.baseline import (
+    write_kernelcheck_baseline,
+)
+from mpi_grid_redistribute_tpu.analysis.core import run_gridlint
+from mpi_grid_redistribute_tpu.analysis.kernelcheck import (
+    BlockRef,
+    KernelCase,
+    KernelFinding,
+    KernelSpec,
+    PallasSite,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _copy_kernel(in_ref, out_ref):
+    out_ref[:] = in_ref[:]
+
+
+def _plus_one_kernel(in_ref, out_ref):
+    out_ref[:] = in_ref[:] + 1.0
+
+
+def _mk_spec(
+    name,
+    *,
+    in_map,
+    out_map,
+    grid=(4,),
+    shape=(32, 128),
+    block=(8, 128),
+    scatter=False,
+    kernel=_copy_kernel,
+    reference=None,
+    aliases=None,
+):
+    """A runnable single-operand spiked kernel spec."""
+    x = jnp.asarray(
+        np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+    )
+
+    def run(a, interpret):
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(block, in_map, memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec(block, out_map,
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+            input_output_aliases=dict(aliases or {}),
+            interpret=interpret,
+        )(a)
+
+    def build():
+        return KernelCase(args=x, run=run, reference=reference)
+
+    return KernelSpec(name, build, scatter=scatter)
+
+
+def _ref(role, index, shape, dtype="float32", block=None, imap=None,
+         space="vmem"):
+    return BlockRef(
+        role=role,
+        index=index,
+        memory_space=space,
+        array_shape=tuple(shape),
+        dtype=dtype,
+        block_shape=tuple(block) if block else None,
+        index_map=imap,
+    )
+
+
+def _site(grid, ins=(), outs=(), scratch=(), aliases=None,
+          vmem_limit=None):
+    return PallasSite(
+        kernel="spiked",
+        fn_name="k",
+        path="tests/test_kernelcheck.py",
+        line=1,
+        grid=tuple(grid),
+        ins=list(ins),
+        outs=list(outs),
+        scratch=list(scratch),
+        aliases=dict(aliases or {}),
+        vmem_limit_bytes=vmem_limit,
+    )
+
+
+_SPEC = KernelSpec("spiked", lambda: None)
+_SCATTER_SPEC = KernelSpec("spiked", lambda: None, scatter=True)
+
+
+def _run(spec, rules):
+    findings, footprints, _ = kc.run_kernelcheck(
+        {spec.name: spec}, rules=rules
+    )
+    return findings, footprints
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- surface
+
+
+def test_rule_docs_cover_all_ids():
+    assert set(kc.K_RULE_IDS) == set(rk.RULE_DOCS)
+
+
+# ---------------------------------------------------------------- K001
+
+
+def test_k001_fires_on_out_of_bounds_index_map(_devices):
+    spec = _mk_spec(
+        "oob", in_map=lambda i: (i + 1, 0), out_map=lambda i: (i, 0)
+    )
+    findings, _ = _run(spec, ["K001"])
+    assert rules_of(findings) == ["K001"], findings
+    msg = findings[0].message
+    assert "in[0]" in msg and "[1, 4]" in msg and "g0" in msg
+    # the capture points at the REAL launch site (this file)
+    assert findings[0].path == "tests/test_kernelcheck.py"
+
+
+def test_k001_quiet_on_clean_twin(_devices):
+    spec = _mk_spec(
+        "clean", in_map=lambda i: (i, 0), out_map=lambda i: (i, 0)
+    )
+    findings, _ = _run(spec, ["K001", "K002", "K004"])
+    assert findings == [], findings
+
+
+def test_k001_fires_on_negative_index(_devices):
+    spec = _mk_spec(
+        "neg", in_map=lambda i: (i - 1, 0), out_map=lambda i: (i, 0)
+    )
+    findings, _ = _run(spec, ["K001"])
+    assert rules_of(findings) == ["K001"], findings
+    assert "[-1, 2]" in findings[0].message
+
+
+def test_k001_non_affine_map_still_checked_exactly():
+    # enumeration is ground truth: an affine fit of i*i misses, the
+    # exhaustive sweep still proves the bound violation at i=3
+    ref = _ref("in", 0, (32, 128), block=(8, 128),
+               imap=lambda i: (i * i, 0))
+    findings = rk.check_k001(_site((4,), ins=[ref]), _SPEC)
+    assert rules_of(findings) == ["K001"], findings
+    assert "[0, 9]" in findings[0].message
+
+
+def test_k001_and_g005_are_disjoint(tmp_path, _devices):
+    """The AST/semantic split, spiked from the kernelcheck side: the
+    SAME out-of-bounds launch is lexically impeccable (G005 quiet) yet
+    semantically broken (K001 fires); a lexically-defaulted launch
+    (G005 fires) is semantically fine whole-array (K001/K002 quiet)."""
+    # twin A: lexically clean, semantically out of bounds
+    src = tmp_path / "pallas_fix.py"
+    src.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _kernel(in_ref, out_ref):
+            out_ref[:] = in_ref[:] + 1.0
+
+        def launch(x):
+            return pl.pallas_call(
+                _kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i + 1, 0),
+                                       memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0),
+                                       memory_space=pltpu.VMEM),
+                out_shape=x,
+            )(x)
+    """))
+    g_findings = run_gridlint([str(tmp_path)], root=str(tmp_path))
+    assert g_findings == [], g_findings  # G005 cannot see the bounds
+    spec = _mk_spec(
+        "twin_a", in_map=lambda i: (i + 1, 0), out_map=lambda i: (i, 0)
+    )
+    k_findings, _ = _run(spec, ["K001"])
+    assert rules_of(k_findings) == ["K001"]
+
+    # twin B: lexically defaulted (G005's concern), semantically fine
+    src.write_text(textwrap.dedent("""
+        from jax.experimental import pallas as pl
+
+        def launch(kernel, x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+    """))
+    g_findings = run_gridlint([str(tmp_path)], root=str(tmp_path))
+    assert rules_of(g_findings) == ["G005"], g_findings
+
+    x = jnp.asarray(np.arange(128, dtype=np.float32).reshape(1, 128))
+
+    def run_b(a, interpret):
+        return pl.pallas_call(
+            _copy_kernel,
+            out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+            interpret=interpret,
+        )(a)
+
+    spec_b = KernelSpec(
+        "twin_b", lambda: KernelCase(args=x, run=run_b, reference=None)
+    )
+    k_findings, _ = _run(spec_b, ["K001", "K002"])
+    assert k_findings == [], k_findings
+
+
+# ---------------------------------------------------------------- K002
+
+
+def test_k002_fires_on_scatter_write_overlap(_devices):
+    spec = _mk_spec(
+        "overlap",
+        in_map=lambda i: (0, 0),
+        out_map=lambda i: (0, 0),
+        grid=(2,),
+        shape=(16, 128),
+        block=(8, 128),
+        scatter=True,
+    )
+    findings, _ = _run(spec, ["K002"])
+    assert rules_of(findings) == ["K002"], findings
+    msgs = "\n".join(f.message for f in findings)
+    assert "write overlap" in msgs and "coverage gap" in msgs
+
+
+def test_k002_fires_on_coverage_gap(_devices):
+    spec = _mk_spec(
+        "gap",
+        in_map=lambda i: (i, 0),
+        out_map=lambda i: (0, 0),
+        grid=(4,),
+    )
+    findings, _ = _run(spec, ["K002"])
+    assert any(
+        "coverage gap" in f.message and "3 of 4" in f.message
+        for f in findings
+    ), findings
+
+
+def test_k002_consecutive_revisit_is_legal(_devices):
+    # the driftbin shape: the same out block accumulated across the
+    # fast (last) grid axis — consecutive in execution order, legal
+    spec = _mk_spec(
+        "revisit_ok",
+        in_map=lambda i, j: (i, 0),
+        out_map=lambda i, j: (i, 0),
+        grid=(2, 2),
+        shape=(16, 128),
+        block=(8, 128),
+    )
+    findings, _ = _run(spec, ["K002"])
+    assert findings == [], findings
+
+
+def test_k002_fires_on_non_consecutive_revisit(_devices):
+    # transposed: the same block revisited on the SLOW axis — the
+    # pipeline flushes it in between, later steps clobber
+    spec = _mk_spec(
+        "revisit_bad",
+        in_map=lambda i, j: (j, 0),
+        out_map=lambda i, j: (j, 0),
+        grid=(2, 2),
+        shape=(16, 128),
+        block=(8, 128),
+    )
+    findings, _ = _run(spec, ["K002"])
+    assert rules_of(findings) == ["K002"], findings
+    assert "NON-consecutive" in findings[0].message
+
+
+def test_k002_alias_exempts_coverage(_devices):
+    spec = _mk_spec(
+        "aliased",
+        in_map=lambda i: (0, 0),
+        out_map=lambda i: (0, 0),
+        grid=(2,),
+        shape=(16, 128),
+        block=(8, 128),
+        aliases={0: 0},
+    )
+    findings, _ = _run(spec, ["K002"])
+    assert findings == [], findings
+
+
+def test_k002_grid_dim_zero_means_uncovered_output():
+    """The semantic twin of test_gridlint's grid-dim-0 fixture: zero
+    grid steps run, so a non-aliased blocked output is never written."""
+    imap = lambda i, j: (i, 0)  # noqa: E731
+    out = _ref("out", 0, (32, 128), block=(8, 128), imap=imap)
+    findings = rk.check_k002(_site((0, 4), outs=[out]), _SPEC)
+    assert rules_of(findings) == ["K002"], findings
+    assert "4 of 4 block(s) never written" in findings[0].message
+
+
+# ---------------------------------------------------------------- K003
+
+
+def test_k003_fires_on_vmem_overflow():
+    imap = lambda i: (i, 0)  # noqa: E731
+    big_in = _ref("in", 0, (4096, 2048), block=(1024, 2048), imap=imap)
+    big_out = _ref("out", 0, (4096, 2048), block=(1024, 2048), imap=imap)
+    site = _site((4,), ins=[big_in], outs=[big_out])
+    findings = rk.check_k003_budget("spiked", [site])
+    assert rules_of(findings) == ["K003"], findings
+    assert "default ~16 MiB/core" in findings[0].message
+    # a declared (deliberate) budget clears the same footprint
+    site_ok = _site(
+        (4,), ins=[big_in], outs=[big_out], vmem_limit=64 * 2**20
+    )
+    assert rk.check_k003_budget("spiked", [site_ok]) == []
+
+
+def test_k003_footprint_model_pads_and_double_buffers():
+    varying = lambda i: (i, 0)  # noqa: E731
+    const = lambda i: (0, 0)  # noqa: E731
+    site = _site(
+        (4,),
+        ins=[_ref("in", 0, (32, 100), block=(8, 100), imap=varying)],
+        outs=[_ref("out", 0, (32, 100), block=(8, 100), imap=const)],
+        scratch=[
+            _ref("scratch", 0, (7, 100)),
+            _ref("scratch", 1, (2,), dtype="dma_sem", space="semaphore"),
+            _ref("scratch", 2, (4,), dtype="int32", space="smem"),
+        ],
+    )
+    rec = rk.site_footprint(site)
+    lane_padded = 8 * 128 * 4  # (8, 100) f32 -> (8, 128)
+    assert rec["block_bytes"] == 2 * lane_padded + 1 * lane_padded
+    assert rec["scratch_bytes"] == 8 * 128 * 4  # (7,100) -> (8,128)
+    assert rec["smem_bytes"] == 16  # semaphores free, SMEM separate
+    assert rec["vmem_bytes"] == rec["block_bytes"] + rec["scratch_bytes"]
+
+
+def test_k003_compare_footprints_missing_drift_stale():
+    fp = {"path": "p", "grid": [2], "block_bytes": 10,
+          "scratch_bytes": 0, "smem_bytes": 0, "vmem_bytes": 10,
+          "budget_bytes": 100}
+    cur = {"k1": {"peak_vmem_bytes": 10, "sites": [dict(fp)]}}
+    # missing baseline entry
+    findings = rk.compare_footprints(cur, {})
+    assert ["K003"] == rules_of(findings)
+    assert "no committed footprint baseline" in findings[0].message
+    # exact match: clean
+    base = json.loads(json.dumps(cur))
+    assert rk.compare_footprints(cur, base) == []
+    # numeric drift
+    base["k1"]["sites"][0]["vmem_bytes"] = 11
+    findings = rk.compare_footprints(cur, base)
+    assert any("vmem_bytes drifted" in f.message for f in findings)
+    # stale entry only under --check over the full registry
+    base = json.loads(json.dumps(cur))
+    base["ghost"] = {"peak_vmem_bytes": 1, "sites": []}
+    assert rk.compare_footprints(cur, base) == []
+    findings = rk.compare_footprints(cur, base, check_stale=True)
+    assert any("stale footprint baseline" in f.message for f in findings)
+    assert rk.compare_footprints(
+        cur, base, check_stale=True, partial=True
+    ) == []
+
+
+# ---------------------------------------------------------------- K004
+
+
+def test_k004_fires_on_illegal_lane_split():
+    imap = lambda i: (0, i)  # noqa: E731
+    ref = _ref("in", 0, (8, 400), block=(8, 100), imap=imap)
+    findings = rk.check_k004(_site((4,), ins=[ref]), _SPEC)
+    assert rules_of(findings) == ["K004"], findings
+    assert "lane" in findings[0].message and "128" in findings[0].message
+
+
+def test_k004_fires_on_illegal_sublane_split():
+    imap = lambda i: (i, 0)  # noqa: E731
+    ref = _ref("in", 0, (9, 128), block=(3, 128), imap=imap)
+    findings = rk.check_k004(_site((3,), ins=[ref]), _SPEC)
+    assert rules_of(findings) == ["K004"], findings
+    assert "sublane tile 8" in findings[0].message
+
+
+def test_k004_full_dim_blocks_are_legal_padding():
+    # driftbin's (7, w) blocks: 7 is the FULL sublane extent — the
+    # compiler pads, K003 charges it, K004 stays quiet
+    imap = lambda i: (0, i)  # noqa: E731
+    ref = _ref("in", 0, (7, 4096), block=(7, 1024), imap=imap)
+    assert rk.check_k004(_site((4,), ins=[ref]), _SPEC) == []
+
+
+def test_k004_fires_on_8_byte_dtype():
+    ref = _ref("scratch", 0, (8, 128), dtype="float64")
+    findings = rk.check_k004(_site((1,), scratch=[ref]), _SPEC)
+    assert rules_of(findings) == ["K004"], findings
+    assert "no legal TPU VMEM tiling" in findings[0].message
+
+
+# ---------------------------------------------------------------- K005
+
+
+def test_k005_fires_on_missing_reference(_devices):
+    spec = _mk_spec(
+        "noref", in_map=lambda i: (i, 0), out_map=lambda i: (i, 0)
+    )
+    findings, _ = _run(spec, ["K005"])
+    assert rules_of(findings) == ["K005"], findings
+    assert "no registered jnp/XLA reference" in findings[0].message
+
+
+def test_k005_fires_on_bit_mismatch(_devices):
+    spec = _mk_spec(
+        "mismatch",
+        in_map=lambda i: (i, 0),
+        out_map=lambda i: (i, 0),
+        kernel=_plus_one_kernel,
+        reference=lambda a: a,  # wrong twin: identity
+    )
+    findings, _ = _run(spec, ["K005"])
+    assert rules_of(findings) == ["K005"], findings
+    assert "not bit-identical" in findings[0].message
+    assert "4096 of 4096" in findings[0].message
+
+
+def test_k005_quiet_on_bit_identical_reference(_devices):
+    spec = _mk_spec(
+        "exact",
+        in_map=lambda i: (i, 0),
+        out_map=lambda i: (i, 0),
+        kernel=_plus_one_kernel,
+        reference=lambda a: a + 1.0,
+    )
+    findings, _ = _run(spec, ["K005"])
+    assert findings == [], findings
+
+
+# -------------------------------------------------------- suppressions
+
+
+def test_suppression_line_and_file_level(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text(
+        "x = 1  # kernelcheck: disable=K001\n"
+        "# kernelcheck: disable-file=K004\n"
+    )
+    f1 = KernelFinding("K001", "k", "m", path=str(src), line=1)
+    f2 = KernelFinding("K004", "k", "m", path=str(src), line=2)
+    f3 = KernelFinding("K002", "k", "m", path=str(src), line=1)
+    kept, n_suppressed = kc._apply_suppressions([f1, f2, f3])
+    assert n_suppressed == 2
+    assert [f.rule for f in kept] == ["K002"]
+    # a gridlint pragma must NOT silence K-rules (own namespace)
+    src.write_text("y = 1  # gridlint: disable=K001\n")
+    kept, n_suppressed = kc._apply_suppressions(
+        [KernelFinding("K001", "k", "m", path=str(src), line=1)]
+    )
+    assert n_suppressed == 0 and len(kept) == 1
+
+
+# ------------------------------------------------- registry + capture
+
+
+def test_registry_capture_driftbin_site(_devices):
+    kernels = kc.default_kernels()
+    case, sites = kc.capture_kernel(kernels["driftbin_v8_n2048"])
+    assert len(sites) == 1
+    s = sites[0]
+    assert s.path == "mpi_grid_redistribute_tpu/ops/pallas_driftbin.py"
+    assert s.grid == (2, 8)
+    assert s.aliases == {0: 0}
+    assert [r.blocked for r in s.outs] == [True, True]
+    assert s.ins[0].block_shape == (7, 1024)
+
+
+def test_registry_capture_scatter_records_compiler_params(_devices):
+    kernels = kc.default_kernels()
+    case, sites = kc.capture_kernel(kernels["scatter_rows_16384x7"])
+    assert len(sites) == 1
+    s = sites[0]
+    assert s.vmem_limit_bytes == 100 * 1024 * 1024
+    assert any(r.memory_space == "semaphore" for r in s.scratch)
+    assert any(r.memory_space == "smem" for r in s.ins)
+
+
+def test_registry_static_rules_clean(_devices):
+    findings, footprints, _ = kc.run_kernelcheck(
+        kc.default_kernels(), rules=["K000", "K001", "K002", "K004"]
+    )
+    assert findings == [], findings
+    assert footprints == {}  # K003 not selected -> no table
+
+
+def test_k000_fires_on_fallback_taking_case(_devices):
+    from mpi_grid_redistribute_tpu.ops import pallas_dfscan
+
+    # rows below any block and a non-kernel path: 1000 is fine, but an
+    # entry point that never reaches pallas_call must be flagged — use
+    # a run() that skips the kernel entirely
+    def run(a, interpret):
+        return a * 2.0
+
+    spec = KernelSpec(
+        "fallback",
+        lambda: KernelCase(
+            args=jnp.ones((4, 4), jnp.float32), run=run, reference=None
+        ),
+    )
+    findings, _ = _run(spec, ["K000"])
+    assert rules_of(findings) == ["K000"], findings
+    assert "no pallas_call captured" in findings[0].message
+    del pallas_dfscan
+
+
+def test_k000_fires_on_broken_build(_devices):
+    def bad_build():
+        raise RuntimeError("no such shape")
+
+    spec = KernelSpec("broken", bad_build)
+    findings, _ = _run(spec, ["K001"])  # K000 build failures always fire
+    assert rules_of(findings) == ["K000"], findings
+    assert "failed to build/trace" in findings[0].message
+
+
+# ------------------------------------------------------ CLI + baseline
+
+
+def test_cli_list_rules_and_usage_errors(capsys):
+    assert kc.main(["--list-rules"]) == 0
+    assert "K003" in capsys.readouterr().out
+    assert kc.main(["--rules", "K999"]) == 2
+    assert kc.main(["--kernels", "nope"]) == 2
+
+
+def test_cli_baseline_roundtrip_and_drift(tmp_path, capsys, _devices):
+    bp = str(tmp_path / "kb.json")
+    rc = kc.main(
+        ["--kernels", "dfscan_300x256", "--update-baseline",
+         "--baseline", bp]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = kc.main(
+        ["--kernels", "dfscan_300x256", "--rules", "K003",
+         "--baseline", bp]
+    )
+    assert rc == 0, capsys.readouterr().out
+    capsys.readouterr()
+    with open(bp) as fh:
+        doc = json.load(fh)
+    doc["footprints"]["dfscan_300x256"]["peak_vmem_bytes"] += 4096
+    with open(bp, "w") as fh:
+        json.dump(doc, fh)
+    rc = kc.main(
+        ["--kernels", "dfscan_300x256", "--rules", "K003",
+         "--baseline", bp]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "drifted" in out
+
+
+def test_cli_check_baseline_mode(tmp_path, capsys):
+    missing = str(tmp_path / "none.json")
+    assert kc.main(["--check-baseline", "--baseline", missing]) == 1
+    assert "no footprint baseline" in capsys.readouterr().out
+    bp = str(tmp_path / "kb.json")
+    rows = {
+        name: {"peak_vmem_bytes": 1, "sites": []}
+        for name in kc.default_kernels()
+    }
+    rows["ghost_kernel"] = {"peak_vmem_bytes": 1, "sites": []}
+    write_kernelcheck_baseline(bp, rows)
+    assert kc.main(["--check-baseline", "--baseline", bp]) == 1
+    assert "ghost_kernel" in capsys.readouterr().out
+    del rows["ghost_kernel"]
+    write_kernelcheck_baseline(bp, rows)
+    assert kc.main(["--check-baseline", "--baseline", bp]) == 0
+
+
+def test_cli_json_and_sarif_formats(capsys, _devices):
+    rc = kc.main(
+        ["--kernels", "dfscan_300x256", "--rules", "K001,K002,K004",
+         "--format", "json"]
+    )
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert data["findings"] == []
+    assert data["kernels"] == ["dfscan_300x256"]
+    rc = kc.main(
+        ["--kernels", "dfscan_300x256", "--rules", "K001",
+         "--format", "sarif"]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    run0 = doc["runs"][0]
+    assert run0["tool"]["driver"]["name"] == "kernelcheck"
+    assert {r["id"] for r in run0["tool"]["driver"]["rules"]} == set(
+        kc.K_RULE_IDS
+    )
+
+
+def test_repo_gate_check_exits_zero(_devices):
+    """The committed registry + baseline must be clean at HEAD — the
+    same gate `make kernelcheck` and check_all.py enforce (includes
+    the K005 interpret execution of every shipped kernel)."""
+    assert kc.main(["--check"]) == 0
+
+
+def test_cli_script_entry_point():
+    """scripts/kernelcheck.py runs standalone (it pins the CPU
+    platform itself)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)  # the wrapper must pin cpu itself
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "kernelcheck.py"),
+            "--list-kernels",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "driftbin_v8_n2048" in proc.stdout

@@ -1,0 +1,79 @@
+"""Within-tile double-float prefix-sum kernel (ops/pallas_dfscan.py)
+vs the XLA Hillis-Steele loop it replaces (deposit._df_cumsum) — bit
+level, interpret mode on CPU. The kernel runs the IDENTICAL
+_two_sum/_df_add float sequence in the same order (adds/subs only, so
+no fma contraction can split the paths), hence both hi and lo planes
+must match exactly, including the row-padding slice."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_grid_redistribute_tpu.ops import deposit, pallas_dfscan
+
+
+def _xla_twin(x):
+    hi, lo = jax.jit(functools.partial(deposit._df_cumsum, axis=1))(x)
+    return np.asarray(hi), np.asarray(lo)
+
+
+@pytest.mark.parametrize(
+    "rows,tile",
+    [
+        (100, 256),  # single partial block (padded to 256)
+        (256, 128),  # exactly one block, smaller tile
+        (300, 512),  # grid (2,): block boundary + padding tail
+    ],
+)
+def test_dfscan_matches_xla_twin_bits(rng, _devices, rows, tile):
+    r = np.random.default_rng(hash((rows, tile)) % 2**32)
+    x = jnp.asarray(r.standard_normal((rows, tile)).astype(np.float32))
+    hi_p, lo_p = pallas_dfscan.tile_df_cumsum_rows(x, interpret=True)
+    hi_x, lo_x = _xla_twin(x)
+    np.testing.assert_array_equal(
+        np.asarray(hi_p).view(np.uint32), hi_x.view(np.uint32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lo_p).view(np.uint32), lo_x.view(np.uint32)
+    )
+
+
+def test_dfscan_hostile_magnitudes(rng, _devices):
+    """Catastrophic-cancellation bait: mixed huge/tiny magnitudes and
+    signs is exactly where the compensated lo plane earns its keep —
+    and where any reassociation between the two paths would show."""
+    r = np.random.default_rng(77)
+    rows, tile = 64, 256
+    mags = r.choice([1e-30, 1e-8, 1.0, 1e8, 1e30], size=(rows, tile))
+    x = (r.standard_normal((rows, tile)) * mags).astype(np.float32)
+    x[3, :8] = 0.0  # exact zeros mid-stream
+    xj = jnp.asarray(x)
+    hi_p, lo_p = pallas_dfscan.tile_df_cumsum_rows(xj, interpret=True)
+    hi_x, lo_x = _xla_twin(xj)
+    np.testing.assert_array_equal(
+        np.asarray(hi_p).view(np.uint32), hi_x.view(np.uint32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lo_p).view(np.uint32), lo_x.view(np.uint32)
+    )
+
+
+def test_dfscan_prefix_is_inclusive(rng, _devices):
+    """Sanity anchor independent of the twin: the last prefix equals a
+    float64 row sum to double-float accuracy."""
+    r = np.random.default_rng(5)
+    rows, tile = 32, 256
+    x = r.standard_normal((rows, tile)).astype(np.float32)
+    hi, lo = pallas_dfscan.tile_df_cumsum_rows(
+        jnp.asarray(x), interpret=True
+    )
+    total = np.asarray(hi[:, -1], np.float64) + np.asarray(
+        lo[:, -1], np.float64
+    )
+    np.testing.assert_allclose(
+        total, x.astype(np.float64).sum(axis=1), rtol=1e-12, atol=1e-10
+    )

@@ -1,0 +1,114 @@
+"""Segmented CIC deposit kernel (ops/pallas_segdep.py) vs the XLA
+segment_sum fallback — interpret mode on CPU.
+
+The two engines share :func:`_corner_weights`, so per-particle channel
+VALUES are identical bits; only the per-cell SUMMATION order differs
+(MXU chunk accumulation vs scatter-add). Bit-identity across engines
+is therefore asserted on DYADIC data: ``rel`` drawn from multiples of
+1/4 makes every corner weight a multiple of 1/16, and with ~a dozen
+rows per cell the partial sums stay exactly representable in f32 —
+any order sums to the same bits. Generic float data gets an allclose
+gate against a float64 oracle instead (that tolerance, not bit
+equality, is the cross-engine contract for arbitrary reals)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_grid_redistribute_tpu.ops import pallas_segdep
+
+
+def _dyadic_case(seed, n, n_cells, d, sentinel_tail):
+    r = np.random.default_rng(seed)
+    keys = np.sort(
+        r.integers(0, n_cells, size=n - sentinel_tail)
+    ).astype(np.int32)
+    keys = np.concatenate(
+        [keys, np.full((sentinel_tail,), n_cells, np.int32)]
+    )
+    # multiples of 1/4 in [0, 8): corner weights become multiples of
+    # 1/16, so every per-cell sum is exact in f32 (order-independent)
+    rel = (r.integers(0, 32, size=(d, n)) * 0.25).astype(np.float32)
+    return jnp.asarray(keys), jnp.asarray(rel)
+
+
+def _xla_twin(keys, rel, mass, n_cells, vblock, d):
+    return np.asarray(
+        jax.jit(
+            lambda k, rl: pallas_segdep._segsum_xla(
+                k, rl, mass, n_cells, vblock, d
+            )
+        )(keys, rel)
+    )
+
+
+@pytest.mark.parametrize(
+    "n,n_cells,d,vblock",
+    [
+        (2048, 256, 2, (8, 8)),  # single T-block
+        (6000, 512, 2, (8, 8)),  # grid (2,): chunk boundary mid-stream
+        (3000, 200, 3, (4, 4, 4)),  # 3-D: 8 channels, odd cell count
+    ],
+)
+def test_segdep_matches_xla_twin_bits_on_dyadic_data(
+    rng, _devices, n, n_cells, d, vblock
+):
+    keys, rel = _dyadic_case(hash((n, n_cells, d)) % 2**32, n, n_cells,
+                             d, sentinel_tail=n // 20)
+    got = np.asarray(
+        pallas_segdep.segsum_sorted(
+            keys, rel, None, n_cells, vblock, interpret=True
+        )
+    )
+    want = _xla_twin(keys, rel, None, n_cells, vblock, d)
+    assert got.shape == (2**d, n_cells)
+    np.testing.assert_array_equal(
+        got.view(np.uint32), want.view(np.uint32)
+    )
+
+
+def test_segdep_all_sentinel_stream(rng, _devices):
+    """A fully-invalid stream (every key = the n_cells sentinel) must
+    deposit exactly zero everywhere in both engines."""
+    n, n_cells, d, vblock = 1024, 128, 2, (8, 8)
+    keys = jnp.full((n,), n_cells, jnp.int32)
+    r = np.random.default_rng(3)
+    rel = jnp.asarray(
+        (r.integers(0, 32, size=(d, n)) * 0.25).astype(np.float32)
+    )
+    got = np.asarray(
+        pallas_segdep.segsum_sorted(
+            keys, rel, None, n_cells, vblock, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, np.zeros((4, n_cells), np.float32))
+
+
+def test_segdep_generic_floats_match_f64_oracle(rng, _devices):
+    """Arbitrary reals: both engines must sit within f32 summation
+    noise of the float64 scatter-add oracle (bit equality is NOT the
+    contract here — summation order differs by design)."""
+    n, n_cells, d, vblock = 4096, 256, 2, (8, 8)
+    r = np.random.default_rng(9)
+    keys = np.sort(r.integers(0, n_cells, size=n)).astype(np.int32)
+    rel = (r.random((d, n)) * np.array(vblock)[:, None]).astype(
+        np.float32
+    )
+    got = np.asarray(
+        pallas_segdep.segsum_sorted(
+            jnp.asarray(keys), jnp.asarray(rel), None, n_cells, vblock,
+            interpret=True,
+        )
+    )
+    w64 = np.asarray(
+        pallas_segdep._corner_weights(
+            [jnp.asarray(rel[dd]) for dd in range(d)], None, vblock
+        ),
+        np.float64,
+    )
+    oracle = np.zeros((2**d, n_cells), np.float64)
+    for ch in range(2**d):
+        np.add.at(oracle[ch], keys, w64[ch])
+    np.testing.assert_allclose(got, oracle, rtol=2e-5, atol=2e-5)

@@ -778,10 +778,31 @@ def test_cli_script_entry_point():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_check_all_umbrella_merges_five_tools(tmp_path):
-    """scripts/check_all.py: gridlint + progcheck + shardcheck +
-    attribution + racecheck, clean at HEAD, all five SARIF runs merged
-    into the one requested file."""
+def _check_all_registry():
+    """Load scripts/check_all.py's ANALYZERS registry — the single
+    source of truth for the family list, so this test stops needing an
+    N -> N+1 edit every time a family lands."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_check_all", os.path.join(REPO_ROOT, "scripts", "check_all.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.ANALYZERS
+
+
+def test_check_all_umbrella_merges_every_registered_tool(tmp_path):
+    """scripts/check_all.py: every analyzer in its ANALYZERS registry,
+    clean at HEAD, one SARIF run per family merged into the requested
+    file — and every registered baseline actually committed."""
+    analyzers = _check_all_registry()
+    expected = [a.name for a in analyzers]
+    assert len(expected) >= 6 and "kernelcheck" in expected
+    for a in analyzers:
+        assert os.path.exists(os.path.join(REPO_ROOT, a.baseline)), (
+            f"{a.name}: registered baseline {a.baseline} is not committed"
+        )
     out_path = str(tmp_path / "merged.sarif")
     proc = subprocess.run(
         [
@@ -798,7 +819,11 @@ def test_check_all_umbrella_merges_five_tools(tmp_path):
     with open(out_path) as fh:
         merged = json.load(fh)
     names = [r["tool"]["driver"]["name"] for r in merged["runs"]]
-    assert names == [
-        "gridlint", "progcheck", "shardcheck", "attribution", "racecheck",
-    ]
+    assert names == expected
     assert all(r["results"] == [] for r in merged["runs"])
+    # per-analyzer wall-time must stay visible (lint-growth telemetry)
+    for name in expected:
+        assert any(
+            line.startswith(f"check: {name} clean") and line.endswith("s)")
+            for line in proc.stdout.splitlines()
+        ), proc.stdout
