@@ -4,7 +4,7 @@ PY ?= python
 	soak soak-smoke rebalance-smoke service-bench progcheck \
 	progcheck-baseline shardcheck shardcheck-baseline check \
 	attribution attribution-check racecheck racecheck-baseline \
-	kernelcheck kernelcheck-baseline
+	kernelcheck kernelcheck-baseline incident-demo
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -85,14 +85,14 @@ service-bench:
 
 # every analyzer family in --check text mode, driven off the single
 # ANALYZERS registry in scripts/check_all.py (gridlint G, progcheck J,
-# shardcheck S, attribution, racecheck T, kernelcheck K) — adding a
-# family is one registry row, not a Makefile edit. Exit 0 = clean or
+# shardcheck S, attribution, racecheck T, kernelcheck K, incident-demo
+# I) — adding a family is one registry row, not a Makefile edit. Exit 0 = clean or
 # fully baselined; 1 = new findings or stale baseline entries; 2 =
 # usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:
 	$(PY) scripts/check_all.py --lint
 
-# one-shot CI umbrella: the same six analyzers/gates, SARIF runs merged
+# one-shot CI umbrella: the same seven analyzers/gates, SARIF runs merged
 # into a single analysis_merged.sarif for one code-scanning upload.
 # Per-analyzer wall-time is printed so lint growth stays visible;
 # `--analyzers NAME[,NAME]` subsets the registry for fast local loops.
@@ -149,6 +149,16 @@ racecheck:
 # justification — a bare regen is not a justification)
 racecheck-baseline:
 	$(PY) scripts/racecheck.py --write-baseline
+
+# incident observatory smoke (ISSUE 17, also inside `make check`): a
+# fault-injected supervised run on the numpy backend must leave
+# flight-recorder bundles behind (alert- AND fault-triggered), every
+# index.json must carry the triggering step context's trace id, the
+# per-rule debounce must hold across restarts, and the frozen journal
+# must export to a Perfetto trace with causal flow arrows. See
+# telemetry/incident.py and scripts/incident.py.
+incident-demo:
+	JAX_PLATFORMS=cpu $(PY) scripts/incident_demo.py --check
 
 # kernelcheck alone: capture every registered Pallas kernel's
 # pallas_call anatomy via jax.eval_shape (no execution) and gate

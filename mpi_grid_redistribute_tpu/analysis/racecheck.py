@@ -14,10 +14,13 @@ code, no jax.
 The model (:class:`ThreadModel`) infers, project-wide:
 
 * **thread roots** — ``threading.Thread(target=f)`` creation sites
-  (with daemon/joined facts from a module-wide alias scan) and every
+  (with daemon/joined facts from a module-wide alias scan), every
   method of an ``http.server`` request-handler subclass (the
   ThreadingHTTPServer pool; flagged ``multi`` because the pool can run
-  the same method concurrently with itself);
+  the same method concurrently with itself), and every HealthMonitor
+  callback registration (``add_callback`` / ``on_alert=`` — callbacks
+  run inline on whichever thread evaluates, so their bodies, e.g. the
+  flight recorder's capture path, are analyzed like spawned targets);
 * **reachability** — a call-graph closure per root over class-aware,
   import-resolved (including relative imports) call edges, plus a
   ``main`` closure seeded from every function no spawned root reaches;
@@ -950,8 +953,71 @@ class ThreadModel:
                             marked_writer=self._fn_marked_writer(mkey),
                         )
                     )
+        # callback roots: HealthMonitor callbacks (``*.add_callback(fn)``
+        # / ``HealthMonitor(on_alert=fn)``) run inline on WHICHEVER
+        # thread calls evaluate() — the driver loop, the demo drive
+        # thread, an HTTP handler — so the callback body (e.g. the
+        # flight recorder's capture path) must be analyzed like a
+        # spawned target that can race any of them. ``multi``: distinct
+        # evaluating threads can run the same callback concurrently.
+        for f in list(self.fns.values()):
+            for cf in f.calls:
+                call = cf.node
+                if last_attr(cf.name) == "add_callback":
+                    expr = get_arg(call, 0, "cb")
+                elif (
+                    self._constructor_class(f.relpath, cf.name)
+                    == "HealthMonitor"
+                ):
+                    expr = get_arg(call, None, "on_alert")
+                else:
+                    continue
+                if expr is None:
+                    continue
+                tks = self._resolve_callback(f, expr)
+                for tk in tks or [None]:
+                    if tk is not None:
+                        desc = tk[1]
+                        label = f"callback:{desc}@{tk[0]}"
+                    else:
+                        desc = (
+                            dotted_name(expr)
+                            if not isinstance(expr, ast.Lambda)
+                            else None
+                        ) or "<unresolved>"
+                        label = f"callback:{desc}@{f.relpath}"
+                    self.roots.append(
+                        ThreadRoot(
+                            label=label, kind="callback", fnkey=tk,
+                            target_desc=desc, created_in=f.key,
+                            relpath=f.relpath, line=call.lineno,
+                            daemon=True, joined=True, multi=True,
+                            marked_writer=(
+                                self._fn_marked_writer(tk)
+                                if tk
+                                else False
+                            ),
+                        )
+                    )
         for r in self.roots:
             self.root_by_label.setdefault(r.label, r)
+
+    def _resolve_callback(
+        self, f: ThreadFn, expr: Optional[ast.AST]
+    ) -> List[FnKey]:
+        """Thread-target resolution plus the registration idiom
+        :func:`_resolve_target` cannot see: ``obj.method`` where ``obj``
+        was constructed from a project class in scope (the
+        ``fr = FlightRecorder(...); monitor.add_callback(fr.on_finding)``
+        shape of :func:`...telemetry.incident.install`)."""
+        tks = self._resolve_target(f, expr)
+        if tks:
+            return tks
+        if isinstance(expr, ast.Attribute):
+            cls = self._class_of_expr(f, expr.value)
+            if cls:
+                return self._lookup_method(cls, expr.attr)
+        return []
 
     # -- call resolution ------------------------------------------------
 

@@ -33,6 +33,7 @@ from mpi_grid_redistribute_tpu import oracle
 from mpi_grid_redistribute_tpu.parallel import exchange, mesh as mesh_lib
 from mpi_grid_redistribute_tpu.parallel import halo as halo_lib
 from mpi_grid_redistribute_tpu.parallel.halo import HaloResult
+from mpi_grid_redistribute_tpu.telemetry import context as context_lib
 from mpi_grid_redistribute_tpu.telemetry import flow as flow_lib
 from mpi_grid_redistribute_tpu.telemetry import health as health_lib
 from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
@@ -1018,6 +1019,18 @@ class GridRedistribute:
         )
         self._call_index += 1
         self._last_row_bytes = report_lib.row_bytes_of(positions, *fields)
+        # call-scoped step context: every event this call journals
+        # (redistribute, capacity_grow, overflow_window_*, alert) carries
+        # ctx_call in its envelope, joining it back to this invocation
+        with context_lib.scoped(call=self._call_index):
+            return self._redistribute_attempts(
+                positions, fields, count, n_local
+            )
+
+    def _redistribute_attempts(
+        self, positions, fields, count, n_local
+    ) -> RedistributeResult:
+        # the grow-and-retry loop of redistribute(), context already set
         max_attempts = 5
         for _ in range(max_attempts):
             cap, out_cap = self._capacities(n_local)

@@ -51,6 +51,7 @@ import numpy as np
 
 from mpi_grid_redistribute_tpu.service.faults import FaultPlan, StallError
 from mpi_grid_redistribute_tpu.telemetry import StepRecorder
+from mpi_grid_redistribute_tpu.telemetry import context as context_lib
 from mpi_grid_redistribute_tpu.telemetry.health import HealthMonitor
 from mpi_grid_redistribute_tpu.telemetry.profiler import ProfilerSession
 from mpi_grid_redistribute_tpu.utils import checkpoint
@@ -133,6 +134,20 @@ class DriverConfig:
     # event. None = off; an unavailable profiler degrades to a no-op
     # (armed=False in the event), never a crash.
     profile_dir: Optional[str] = None
+    # incident observatory (ISSUE 17): when set, a
+    # telemetry.incident.FlightRecorder is attached to the health
+    # monitor — every ALERT finding (plus injected faults scanned at
+    # boundaries/close) freezes a debounced incident bundle into this
+    # directory. The flight recorder is keyed on the shared journal so
+    # its debounce/counter state survives supervisor restarts.
+    incident_dir: Optional[str] = None
+    incident_debounce_s: float = 60.0  # per-rule bundle debounce window
+    # multi-window error-budget burn-rate alerting over the same SLO
+    # thresholds (telemetry.health.burn_rate_*): pure alerting — burn
+    # ALERTs capture bundles and flip /healthz but do not raise
+    # SLOBreachError mid-run (the point-in-time slo_* rules own the
+    # restart actuation). Windows are (slo_window, 4 * slo_window).
+    burn_rate_alerts: bool = False
 
 
 class ServiceDriver:
@@ -194,6 +209,7 @@ class ServiceDriver:
         self._chunk_done: Optional[float] = None
         self._install_slo_rules()
         self._install_rebalance_rule()
+        self._flight = self._install_flight_recorder()
 
     def _install_slo_rules(self) -> None:
         # the monitor is SHARED across supervisor restarts, so install
@@ -215,6 +231,44 @@ class ServiceDriver:
                     cfg.slo_dropped_p99, window=cfg.slo_window
                 )
             )
+        if not cfg.burn_rate_alerts:
+            return
+        # burn-rate upgrades of the same SLO thresholds: fast window =
+        # the SLO window, slow window = 4x — sustained low-grade burn
+        # the point-in-time p99 forgives still pages (ISSUE 17)
+        slow = 4 * cfg.slo_window
+        if cfg.slo_latency_p99_s > 0 and "burn_rate_latency" not in have:
+            self.monitor.rules.append(
+                health_lib.burn_rate_latency(
+                    cfg.slo_latency_p99_s,
+                    fast_window=cfg.slo_window,
+                    slow_window=slow,
+                )
+            )
+        if cfg.slo_dropped_p99 >= 0 and "burn_rate_dropped" not in have:
+            self.monitor.rules.append(
+                health_lib.burn_rate_dropped(
+                    cfg.slo_dropped_p99,
+                    fast_window=cfg.slo_window,
+                    slow_window=slow,
+                )
+            )
+
+    def _install_flight_recorder(self):
+        # idempotent per shared recorder (telemetry.incident.install):
+        # a restarted driver re-registers the SAME flight recorder on
+        # its fresh monitor, so debounce clocks and the bundle counter
+        # survive the restart instead of re-capturing a standing alert
+        if not self.cfg.incident_dir:
+            return None
+        from mpi_grid_redistribute_tpu.telemetry import incident as incident_lib
+
+        return incident_lib.install(
+            self.monitor,
+            self.recorder,
+            self.cfg.incident_dir,
+            debounce_s=self.cfg.incident_debounce_s,
+        )
 
     def _install_rebalance_rule(self) -> None:
         # replace the stock WARN-severity imbalance_ratio rule with an
@@ -465,15 +519,26 @@ class ServiceDriver:
             "grid_shape": list(cfg.grid_shape),
         }
 
+        # thread-locals don't cross the spawn: hand the writer a child
+        # of the loop's context so anything it journals (or an incident
+        # capture racing it) attributes to the step being snapshotted
+        ctx = context_lib.current()
+        wctx = (
+            ctx.child(step=step, origin="snapshot-writer")
+            if ctx is not None
+            else None
+        )
+
         def write() -> None:
-            try:
-                checkpoint.save(
-                    path, arrays, nranks=self.nranks, step=step,
-                    extra=extra,
-                )
-            except Exception as e:  # surfaced by join_snapshot_writer
-                with self._writer_lock:
-                    self._writer_error = f"{type(e).__name__}: {e}"
+            with context_lib.use(wctx):
+                try:
+                    checkpoint.save(
+                        path, arrays, nranks=self.nranks, step=step,
+                        extra=extra,
+                    )
+                except Exception as e:  # surfaced by join_snapshot_writer
+                    with self._writer_lock:
+                        self._writer_error = f"{type(e).__name__}: {e}"
 
         self.join_snapshot_writer()  # at most one write in flight
         cadence_s = float(cfg.snapshot_every) * float(self._wall_ema or 0.0)
@@ -837,6 +902,10 @@ class ServiceDriver:
         # snapshot/health hooks, on the step the chunk just ended at;
         # _chunk_len_from guarantees chunks never straddle a boundary
         cfg = self.cfg
+        # freeze fault bundles BEFORE the health pass: a health finding
+        # the fault provoked may raise (SLOBreachError) out of the check
+        if self._flight is not None:
+            self._flight.scan_faults()
         if cfg.snapshot_every and self.step % cfg.snapshot_every == 0:
             self._materialize_state()
             path = self.snapshot()
@@ -986,22 +1055,38 @@ class ServiceDriver:
             recorder=self.recorder,
             label=f"run@{self.step}",
         )
+        # causal step context (telemetry/context.py): inherit the
+        # supervisor's per-attempt context when one is active (so the
+        # trace id spans restarts and ctx_attempt rides along), else
+        # open a deterministic root trace derived from the config seed.
+        # Each loop iteration re-scopes to the chunk's first step, so
+        # every event it journals (redistribute, step_latency, snapshot,
+        # alert, fault_injected) carries ctx_step in its envelope.
+        cur = context_lib.current()
+        root = (
+            cur.child(origin="driver")
+            if cur is not None
+            else context_lib.StepContext(
+                trace=f"svc-{cfg.seed:08x}", origin="driver"
+            )
+        )
         try:
-            with session:
+            with context_lib.use(root), session:
                 while self.step < end:
-                    self._ensure_built()
-                    if pending is not None:
-                        pending = self._retire_chunk(pending, end)
-                        continue
-                    n = self._chunk_len_from(self.step, end)
-                    if (
-                        n == 1
-                        or cfg.backend != "jax"
-                        or not self._resident_ok()
-                    ):
-                        self._run_chunk_eager(n)
-                        continue
-                    pending = self._dispatch_chunk(n)
+                    with context_lib.scoped(step=self.step + 1):
+                        self._ensure_built()
+                        if pending is not None:
+                            pending = self._retire_chunk(pending, end)
+                            continue
+                        n = self._chunk_len_from(self.step, end)
+                        if (
+                            n == 1
+                            or cfg.backend != "jax"
+                            or not self._resident_ok()
+                        ):
+                            self._run_chunk_eager(n)
+                            continue
+                        pending = self._dispatch_chunk(n)
         finally:
             self._materialize_state()
         return self.state
@@ -1012,6 +1097,10 @@ class ServiceDriver:
         self.join_snapshot_writer()
         if self._rd is not None:
             self._rd.flush_overflow_checks()
+        if self._flight is not None:
+            # a fault that crashed the attempt before the next boundary
+            # still leaves its incident bundle behind
+            self._flight.scan_faults()
         self.export_journal()
 
     def abandon(self) -> Optional[str]:
@@ -1142,6 +1231,12 @@ def main(argv=None) -> int:
              "the env spelling; journaled as profile_session events)",
     )
     p.add_argument(
+        "--incident-dir", default=None, metavar="DIR",
+        help="freeze a debounced incident bundle into DIR on every "
+             "ALERT / injected fault (telemetry.incident.FlightRecorder; "
+             "inspect with scripts/incident.py)",
+    )
+    p.add_argument(
         "--final-out", default=None,
         help="write the final state (pos/vel/count/step npz) here",
     )
@@ -1175,6 +1270,7 @@ def main(argv=None) -> int:
         rebalance_horizon=args.rebalance_horizon,
         rebalance_cooldown=args.rebalance_cooldown,
         profile_dir=args.profile_dir,
+        incident_dir=args.incident_dir,
     )
     faults = FaultPlan()
     if args.inject_crash is not None:

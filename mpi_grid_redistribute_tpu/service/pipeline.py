@@ -96,7 +96,11 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
     multi-device topology) this DELEGATES to the sequential builder —
     the returned macro is bit-exactly the sequential one, including its
     ``ResidentLayoutError`` on ragged carries — and the degradation is
-    journaled. ``unroll`` is forwarded on that path only; the pipelined
+    journaled. Because this builder runs under the driver's causal step
+    context (``telemetry/context.py``), that ``engine_resolved`` event
+    carries the active ``trace``/``ctx_*`` envelope fields and a ragged
+    carry's ``ResidentLayoutError`` names the trace id, so build-time
+    infeasibilities join against the step that forced the rebuild. ``unroll`` is forwarded on that path only; the pipelined
     scan keeps ``unroll=1`` (the double-buffered carry, not body
     replication, is its overlap mechanism).
 

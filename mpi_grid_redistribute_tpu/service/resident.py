@@ -50,6 +50,7 @@ import jax
 from jax import lax
 
 from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.telemetry import context as context_lib
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
@@ -57,7 +58,12 @@ class ResidentLayoutError(ValueError):
     """The engine's output layout cannot serve as a scan carry (the
     receive capacity no longer equals ``n_local``, so step k+1's input
     shape would differ from step k's). The driver falls back to the
-    eager per-step loop, which handles ragged capacities."""
+    eager per-step loop, which handles ragged capacities.
+
+    When a causal step context is active (``telemetry/context.py`` —
+    any driver-run build path), the message names its trace id, so the
+    infeasibility joins against the journal events of the step that
+    provoked the rebuild."""
 
 
 def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
@@ -89,9 +95,11 @@ def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
     fn, cap, out_cap = rd.engine_fn(positions, *fields)
     n_local = positions.shape[0] // rd.nranks
     if out_cap != n_local:
+        trace = context_lib.current_trace()
+        at = f" [trace {trace}]" if trace else ""
         raise ResidentLayoutError(
             f"out_capacity {out_cap} != n_local {n_local}: the scan "
-            "carry needs a shape-invariant state layout"
+            f"carry needs a shape-invariant state layout{at}"
         )
     dt = float(dt)
     unroll = min(max(1, int(unroll)), chunk)

@@ -38,6 +38,7 @@ from typing import Callable, List, NamedTuple, Optional
 import numpy as np
 
 from mpi_grid_redistribute_tpu.telemetry import StepRecorder
+from mpi_grid_redistribute_tpu.telemetry import context as context_lib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,93 +116,108 @@ class Supervisor:
         attempt = 0
         breaches = 0          # CONSECUTIVE SLO-breach failures
         grid_override = None  # set once the shrink policy fires
+        # one causal trace spans the whole supervised incident; each
+        # attempt runs under a child context carrying ctx_attempt, so
+        # every journal line — including this loop's restart decisions —
+        # names the restart generation it belongs to (telemetry/context)
+        root = context_lib.current()
+        if root is None:
+            root = context_lib.StepContext(
+                trace=f"sup-{policy.seed:08x}", origin="supervisor"
+            )
         while True:
-            if grid_override is None:
-                driver = self.driver_factory()
-            else:
-                driver = self.driver_factory(grid_shape=grid_override)
-            self.driver = driver
-            if self._recorder is None:
-                self._recorder = driver.recorder
-            failure: Optional[str] = None
-            try:
-                if not driver.restore_latest():
-                    driver.init_state()
-                driver.run()
-                driver.close()
-            except Exception as e:
-                failure = f"{type(e).__name__}: {e}"
-                note = driver.abandon()
-                if note is not None:
-                    failure = f"{failure} ({note})"
-            if failure is None:
-                code, verdict = driver.healthz()
-                if code == 503:
-                    # a clean exit with an ALERTing health verdict is a
-                    # failure: restart and let recovery clear the alert
-                    reasons = "; ".join(
-                        f["reason"] for f in verdict["findings"]
-                        if f["severity"] == "ALERT"
-                    )
-                    failure = f"healthz 503: {reasons or 'ALERT'}"
+            with context_lib.use(
+                root.child(attempt=attempt, origin="supervisor")
+            ):
+                if grid_override is None:
+                    driver = self.driver_factory()
                 else:
+                    driver = self.driver_factory(grid_shape=grid_override)
+                self.driver = driver
+                if self._recorder is None:
+                    self._recorder = driver.recorder
+                failure: Optional[str] = None
+                try:
+                    if not driver.restore_latest():
+                        driver.init_state()
+                    driver.run()
+                    driver.close()
+                except Exception as e:
+                    failure = f"{type(e).__name__}: {e}"
+                    note = driver.abandon()
+                    if note is not None:
+                        failure = f"{failure} ({note})"
+                if failure is None:
+                    code, verdict = driver.healthz()
+                    if code == 503:
+                        # a clean exit with an ALERTing health verdict is
+                        # a failure: restart, let recovery clear the alert
+                        reasons = "; ".join(
+                            f["reason"] for f in verdict["findings"]
+                            if f["severity"] == "ALERT"
+                        )
+                        failure = f"healthz 503: {reasons or 'ALERT'}"
+                    else:
+                        return SupervisorVerdict(
+                            ok=True, restarts=attempt, gave_up=False,
+                            reason="", step=driver.step,
+                            health=verdict["status"],
+                        )
+                # SLOBreachError failures feed the shrink policy; any
+                # other failure mode resets the consecutive-breach count
+                # (a crash between breaches is not evidence the MESH is
+                # too slow)
+                if "SLOBreachError" in failure:
+                    breaches += 1
+                else:
+                    breaches = 0
+                now = self.clock()
+                restart_times = [
+                    t for t in restart_times if now - t <= policy.window_s
+                ]
+                if len(restart_times) >= policy.max_restarts:
+                    reason = (
+                        f"circuit breaker: {len(restart_times)} restarts "
+                        f"in {policy.window_s:.0f}s window "
+                        f"(last: {failure})"
+                    )
+                    self.recorder.record(
+                        "restart", action="give_up", attempt=attempt,
+                        reason=reason, step=driver.step,
+                    )
+                    # the breaker verdict must not leave the daemon
+                    # snapshot writer running behind it: the failing
+                    # driver was closed or abandoned above, but a
+                    # restore/teardown path that re-armed the writer
+                    # would otherwise escape here
+                    if driver._writer is not None:
+                        driver.abandon()
+                    _, verdict = driver.healthz()
                     return SupervisorVerdict(
-                        ok=True, restarts=attempt, gave_up=False,
-                        reason="", step=driver.step,
+                        ok=False, restarts=attempt, gave_up=True,
+                        reason=reason, step=driver.step,
                         health=verdict["status"],
                     )
-            # SLOBreachError failures feed the shrink policy; any other
-            # failure mode resets the consecutive-breach count (a crash
-            # between breaches is not evidence the MESH is too slow)
-            if "SLOBreachError" in failure:
-                breaches += 1
-            else:
-                breaches = 0
-            now = self.clock()
-            restart_times = [
-                t for t in restart_times if now - t <= policy.window_s
-            ]
-            if len(restart_times) >= policy.max_restarts:
-                reason = (
-                    f"circuit breaker: {len(restart_times)} restarts in "
-                    f"{policy.window_s:.0f}s window (last: {failure})"
-                )
-                self.recorder.record(
-                    "restart", action="give_up", attempt=attempt,
-                    reason=reason, step=driver.step,
-                )
-                # the breaker verdict must not leave the daemon snapshot
-                # writer running behind it: the failing driver was closed
-                # or abandoned above, but a restore/teardown path that
-                # re-armed the writer would otherwise escape here
-                if driver._writer is not None:
-                    driver.abandon()
-                _, verdict = driver.healthz()
-                return SupervisorVerdict(
-                    ok=False, restarts=attempt, gave_up=True,
-                    reason=reason, step=driver.step,
-                    health=verdict["status"],
-                )
-            if policy.shrink_after and breaches >= policy.shrink_after:
-                from mpi_grid_redistribute_tpu.parallel import (
-                    mesh as mesh_lib,
-                )
-
-                old = tuple(driver.cfg.grid_shape)
-                new = mesh_lib.shrink_shape(old)
-                if new != old:
-                    self.recorder.record(
-                        "restart", action="shrink", attempt=attempt,
-                        reason=failure, old_grid=list(old),
-                        new_grid=list(new), step=driver.step,
+                if policy.shrink_after and breaches >= policy.shrink_after:
+                    from mpi_grid_redistribute_tpu.parallel import (
+                        mesh as mesh_lib,
                     )
-                    grid_override = new
-                    breaches = 0
-            backoff = policy.backoff_s(attempt, rng)
-            self.recorder.record(
-                "restart", action="restart", attempt=attempt,
-                reason=failure, backoff_s=backoff, step=driver.step,
-            )
-            self.sleep_fn(backoff)
-            restart_times.append(self.clock())
-            attempt += 1
+
+                    old = tuple(driver.cfg.grid_shape)
+                    new = mesh_lib.shrink_shape(old)
+                    if new != old:
+                        self.recorder.record(
+                            "restart", action="shrink", attempt=attempt,
+                            reason=failure, old_grid=list(old),
+                            new_grid=list(new), step=driver.step,
+                        )
+                        grid_override = new
+                        breaches = 0
+                backoff = policy.backoff_s(attempt, rng)
+                self.recorder.record(
+                    "restart", action="restart", attempt=attempt,
+                    reason=failure, backoff_s=backoff, step=driver.step,
+                )
+                self.sleep_fn(backoff)
+                restart_times.append(self.clock())
+                attempt += 1

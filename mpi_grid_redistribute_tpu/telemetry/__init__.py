@@ -68,6 +68,24 @@ loop:
   ``DriverConfig.profile_dir``), journaled as ``profile_session``
   events.
 
+The incident observatory (ISSUE 17) makes the journal causal and the
+alerts actionable:
+
+* :mod:`.context` — thread-local :class:`~.context.StepContext`
+  (trace id, step/call index, restart attempt, origin thread) merged
+  into every event envelope by the recorder; "which step caused this
+  alert/restart" becomes a join on ``trace``/``ctx_*`` fields.
+* :mod:`.incident` — the :class:`~.incident.FlightRecorder` health
+  callback: on ALERT (or injected fault, or bench REGRESSION) it
+  freezes a debounced incident bundle — journal window, counts,
+  OpenMetrics text, health findings, flow snapshot, env fingerprint,
+  triggering step context — under an ``index.json``
+  (``scripts/incident.py`` CLI; ``GET /incidents`` on the metrics
+  server).
+* :mod:`.health` additionally grew multi-window error-budget burn-rate
+  rules (``burn_rate_latency`` / ``burn_rate_dropped``) and isolates
+  callback exceptions (``callback_error`` events).
+
 Event schema and metric families: ``telemetry/SCHEMA.md``.
 """
 
@@ -119,9 +137,19 @@ from mpi_grid_redistribute_tpu.telemetry.health import (  # noqa: F401
     Finding,
     HealthMonitor,
     HealthRule,
+    burn_rate_dropped,
+    burn_rate_latency,
     default_rules,
     fast_path_fallback,
     snapshot_staleness,
+)
+from mpi_grid_redistribute_tpu.telemetry.context import (  # noqa: F401
+    StepContext,
+)
+from mpi_grid_redistribute_tpu.telemetry.incident import (  # noqa: F401
+    FlightRecorder,
+    list_bundles,
+    load_bundle,
 )
 from mpi_grid_redistribute_tpu.telemetry.traceview import (  # noqa: F401
     to_chrome_trace,

@@ -46,6 +46,19 @@ are set; they actuate the restart/shrink policy, ISSUE 8):
   ``step_latency`` events above the latency SLO. ALERT.
 * ``slo_dropped_rows`` — bucketed p99 of per-step dropped rows above
   the loss SLO (default 0: any sustained loss). ALERT.
+* ``burn_rate_latency`` / ``burn_rate_dropped`` — multi-window
+  error-budget burn rates over the same pow2 histograms: the fraction of
+  recent steps violating the SLO, divided by the budget the objective
+  leaves (1 - objective), checked over a short *fast* window (pages on
+  sudden total breach within minutes of evidence) and a long *slow*
+  window (catches sustained low-grade burn the fast window forgives).
+  The SRE-standard upgrade of the point-in-time p99 rules; the reason
+  string names the window and burn factor that fired. ALERT.
+
+Callbacks registered on the monitor (``add_callback`` /
+``on_alert=``) are isolated: a callback that raises is journaled as a
+``callback_error`` event and evaluation continues with the remaining
+rules — a broken alert sink can never mask a real ALERT.
 """
 
 from __future__ import annotations
@@ -59,6 +72,12 @@ OK = "OK"
 WARN = "WARN"
 ALERT = "ALERT"
 _SEVERITY_ORDER = {OK: 0, WARN: 1, ALERT: 2}
+
+# Event kinds the observability plane itself emits while reacting to
+# findings. Excluded from the alert-dedup clock in
+# :meth:`HealthMonitor.evaluate` so reacting to an alert is never "new
+# evidence" that re-fires the same alert.
+_META_KINDS = ("alert", "callback_error", "incident")
 
 
 class HealthRule(NamedTuple):
@@ -311,6 +330,146 @@ def slo_dropped_rows(
     return HealthRule("slo_dropped_rows", ALERT, fn)
 
 
+def _over_budget(h, threshold: float) -> int:
+    """Events in buckets strictly above the one containing ``threshold``.
+
+    Bucketed like the quantile rules: an observation only counts as an
+    SLO violation once it lands beyond the threshold's own bucket edge,
+    so the burn rate trips on the same evidence an operator sees in the
+    ``/metrics`` histogram — never on sub-bucket noise the exposition
+    cannot show."""
+    for le, cum in h.cumulative():
+        if le >= threshold:
+            return h.count - cum
+    return 0  # unreachable: cumulative() ends with the +Inf bucket
+
+
+def _burn_rate_rule(
+    name: str,
+    kind_key: str,
+    edges,
+    cast,
+    threshold,
+    unit: str,
+    objective: float,
+    fast_window: int,
+    slow_window: int,
+    fast_burn: float,
+    slow_burn: float,
+) -> HealthRule:
+    # shared machinery behind burn_rate_latency / burn_rate_dropped
+    if not 0.0 < objective < 1.0:
+        raise ValueError(f"objective must be in (0, 1), got {objective}")
+    if fast_window < 1:
+        raise ValueError(f"fast_window must be >= 1, got {fast_window}")
+    if slow_window <= fast_window:
+        raise ValueError(
+            f"slow_window must exceed fast_window "
+            f"({slow_window} <= {fast_window})"
+        )
+    if fast_burn <= 0 or slow_burn <= 0:
+        raise ValueError(
+            f"burn factors must be > 0, got {fast_burn}/{slow_burn}"
+        )
+    from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
+
+    budget = 1.0 - objective
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        ev = rec.events("step_latency")
+        # fast window first: it pages at the higher factor, and when both
+        # would fire the short window is the fresher evidence
+        for label, win, factor in (
+            ("fast", fast_window, fast_burn),
+            ("slow", slow_window, slow_burn),
+        ):
+            tail = ev[-win:]
+            if len(tail) < win:
+                continue  # a cold journal is not a breach
+            h = metrics_lib.Histogram((), edges)
+            for e in tail:
+                h.observe(cast(e.data.get(kind_key, 0)))
+            bad = _over_budget(h, threshold)
+            burn = (bad / win) / budget
+            if burn >= factor:
+                return (
+                    f"error budget burning at {burn:.1f}x over the "
+                    f"{label} window (>= {factor:g}x): {bad}/{win} steps "
+                    f"beyond {threshold:g}{unit} against a {budget:.2%} "
+                    f"budget (objective {objective:g})"
+                )
+        return None
+
+    return HealthRule(name, ALERT, fn)
+
+
+def burn_rate_latency(
+    threshold_s: float,
+    objective: float = 0.99,
+    fast_window: int = 16,
+    slow_window: int = 64,
+    fast_burn: float = 8.0,
+    slow_burn: float = 2.0,
+) -> HealthRule:
+    """ALERT when the step-latency error budget burns too fast.
+
+    Multi-window burn-rate alerting (the SRE-standard upgrade of the
+    point-in-time :func:`slo_latency_p99`): over each window the bad
+    fraction is the share of ``step_latency`` events whose seconds land
+    beyond ``threshold_s``'s pow2 bucket, and the burn rate is that
+    fraction divided by the error budget ``1 - objective``. The *fast*
+    window fires at ``fast_burn`` x budget (sudden total breach pages on
+    minutes of evidence); the *slow* window fires at ``slow_burn`` x
+    (sustained low-grade burn that would quietly exhaust the budget).
+    Each window needs to be full before it can fire, and the journaled
+    reason names the window and burn factor that tripped."""
+    from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
+
+    return _burn_rate_rule(
+        "burn_rate_latency",
+        "seconds",
+        metrics_lib.STEP_TIME_EDGES,
+        float,
+        float(threshold_s),
+        "s",
+        objective,
+        fast_window,
+        slow_window,
+        fast_burn,
+        slow_burn,
+    )
+
+
+def burn_rate_dropped(
+    threshold: int = 0,
+    objective: float = 0.99,
+    fast_window: int = 16,
+    slow_window: int = 64,
+    fast_burn: float = 8.0,
+    slow_burn: float = 2.0,
+) -> HealthRule:
+    """ALERT when the dropped-rows error budget burns too fast — the
+    ``grid_dropped_rows`` twin of :func:`burn_rate_latency` (default
+    ``threshold=0``: any step that drops rows spends budget)."""
+    if threshold < 0:
+        raise ValueError(f"threshold must be >= 0, got {threshold}")
+    from mpi_grid_redistribute_tpu.telemetry import metrics as metrics_lib
+
+    return _burn_rate_rule(
+        "burn_rate_dropped",
+        "dropped",
+        metrics_lib.DROPPED_EDGES,
+        int,
+        float(threshold),
+        " rows",
+        objective,
+        fast_window,
+        slow_window,
+        fast_burn,
+        slow_burn,
+    )
+
+
 def default_rules() -> List[HealthRule]:
     return [
         backlog_growth(),
@@ -365,10 +524,16 @@ class HealthMonitor:
         endpoint every few seconds must observe health, not mutate it.
         """
         findings: List[Finding] = []
-        # dedup clock: non-alert events ever journaled — the alert events
-        # this pass records must not count as "new evidence" for the next
+        # dedup clock: non-meta events ever journaled — the alert /
+        # callback_error / incident events an evaluation pass (or its
+        # callbacks, e.g. the flight recorder) records must not count as
+        # "new evidence" for the next pass, or a standing finding would
+        # re-journal itself forever off its own side effects
         rec = self.recorder
-        seq = rec.total_recorded - rec.counts().get("alert", 0)
+        counts = rec.counts()
+        seq = rec.total_recorded - sum(
+            counts.get(k, 0) for k in _META_KINDS
+        )
         for rule in self.rules:
             reason = rule.fn(rec)
             if reason is None:
@@ -389,7 +554,18 @@ class HealthMonitor:
             )
             self._seen[rule.name] = (reason, seq)
             for cb in self.callbacks:
-                cb(f)
+                # a broken sink must never mask a real ALERT (or abort
+                # the rules still unevaluated): journal and keep going
+                try:
+                    cb(f)
+                except Exception as exc:
+                    rec.record(
+                        "callback_error",
+                        rule=rule.name,
+                        callback=getattr(cb, "__qualname__", None)
+                        or type(cb).__name__,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
         status = OK
         for f in findings:
             if _SEVERITY_ORDER[f.severity] > _SEVERITY_ORDER[status]:

@@ -49,6 +49,8 @@ from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
+from . import context as context_lib
+
 
 class Event(NamedTuple):
     """One recorded event: monotone sequence number, host wall time
@@ -156,6 +158,15 @@ class StepRecorder:
         self._counts[kind] = self._counts.get(kind, 0) + 1
         self._seq += 1
         if self.enabled:
+            # Merge the recording thread's active StepContext into the
+            # envelope (telemetry/context.py). Payload keys win: replayed
+            # events (record_at from aggregate/trace_export) already carry
+            # their original attribution and must not be restamped.
+            env = context_lib.envelope_fields()
+            if env:
+                for k, v in env.items():
+                    if k not in data:
+                        data[k] = v
             t = time.time() if when is None else float(when)
             self._ring.append(Event(self._seq, t, kind, data))
 

@@ -12,17 +12,24 @@ Three track families:
   (``ph="i"``) on a per-kind track (one ``tid`` per event kind, labeled
   with thread-name metadata), timestamped with the event's host wall
   time relative to the first retained event. ``alert`` events land on
-  their own track next to the events that caused them.
+  their own track next to the events that caused them. Events whose
+  envelope carries a ``trace`` step context (``telemetry/context.py``)
+  additionally get Perfetto **flow arrows** (``ph="s"``/``ph="f"``):
+  each ``alert`` / ``restart`` / ``incident`` instant is linked back to
+  the latest preceding same-trace cause event, so the UI draws the
+  arrow from the step that burned the budget to the alert it tripped.
 * **Phase spans** (pid 1): :class:`~.phases.PhaseTiming` rows (the
   knockout / ``attribute_phases`` output) become duration events
   (``ph="X"``) laid end to end — each phase's span length is its
   attributed ``delta_s``, so the lane reads as one step's time budget.
 * **Migrate counters** (pid 2): ``migrate_step`` journal events become
   counter tracks (``ph="C"``) for population, backlog, sent — the
-  timeline view of the drift workload unbalancing. Step events are
-  journaled in one batch (their wall times are all equal), so this
-  track uses SYNTHETIC time: ``step * step_seconds`` (default 1 ms per
-  step; pass the measured per-step seconds for an honest axis).
+  timeline view of the drift workload unbalancing. When the journal
+  carries measured ``step_time`` events their host wall times anchor
+  the counter axis (an honest axis for driver runs, which journal step
+  timings at health boundaries); otherwise the axis is SYNTHETIC:
+  ``step * step_seconds`` (default 1 ms per step), since batch-journaled
+  step events all share one wall time.
 
 ``scripts/trace_export.py`` is the CLI wrapper;
 ``GridRedistribute.to_perfetto()`` exports an API instance's journal.
@@ -36,8 +43,14 @@ from typing import Dict, List, Optional, Sequence
 _TRACK_FAMILIES = {
     0: "journal (instant events per kind)",
     1: "phase attribution (duration events)",
-    2: "migrate steps (counter tracks, synthetic time)",
+    2: "migrate steps (counter tracks)",
 }
+
+# pid-0 instants that are *reactions* — flow-arrow targets. They (plus
+# callback_error, another meta kind) never act as flow *sources*: the
+# arrow should point at the workload event that caused the reaction,
+# not at an earlier reaction that shares its trace.
+_EFFECT_KINDS = ("alert", "restart", "incident")
 
 
 def _meta(pid: int, tid: int, what: str, name: str) -> Dict[str, object]:
@@ -90,13 +103,16 @@ def to_chrome_trace(
         journal = recorder.events()
         t0 = journal[0].time if journal else 0.0
         tids: Dict[str, int] = {}
+        inst_ts: List[float] = []
         for e in journal:
             tid = tids.setdefault(e.kind, len(tids))
+            ts = (e.time - t0) * 1e6  # us
+            inst_ts.append(ts)
             events.append(
                 {
                     "name": e.kind,
                     "ph": "i",
-                    "ts": (e.time - t0) * 1e6,  # us
+                    "ts": ts,
                     "pid": 0,
                     "tid": tid,
                     "s": "t",  # thread-scoped instant
@@ -108,6 +124,40 @@ def to_chrome_trace(
             )
         for kind, tid in tids.items():
             events.append(_meta(0, tid, "thread_name", kind))
+
+        # flow arrows: each effect instant (alert/restart/incident) is
+        # linked to the latest preceding same-trace cause event via a
+        # ph="s"/"f" pair sharing an id — Perfetto draws the arrow
+        flow_id = 0
+        last_by_trace: Dict[str, int] = {}
+        for i, e in enumerate(journal):
+            trace = e.data.get("trace")
+            if not isinstance(trace, str):
+                continue
+            if e.kind in _EFFECT_KINDS:
+                j = last_by_trace.get(trace)
+                if j is not None:
+                    flow_id += 1
+                    cause = journal[j]
+                    pair = (
+                        ("s", j, cause.kind, {}),
+                        ("f", i, e.kind, {"bp": "e"}),
+                    )
+                    for ph, idx, kind, extra in pair:
+                        events.append(
+                            {
+                                "name": f"cause:{e.kind}",
+                                "cat": "causal",
+                                "ph": ph,
+                                "id": flow_id,
+                                "ts": inst_ts[idx],
+                                "pid": 0,
+                                "tid": tids[kind],
+                                **extra,
+                            }
+                        )
+            elif e.kind != "callback_error":
+                last_by_trace[trace] = i
 
     # --- pid 1: phase-attribution duration lane -----------------------
     if phase_timings:
@@ -141,12 +191,27 @@ def to_chrome_trace(
             )
             cursor += dur
 
-    # --- pid 2: migrate-step counter tracks (synthetic time) ----------
+    # --- pid 2: migrate-step counter tracks ---------------------------
     if recorder is not None:
         dt_us = (step_seconds if step_seconds else 1e-3) * 1e6
         events.append(_meta(2, 0, "thread_name", "migrate counters"))
-        for e in recorder.events("migrate_step"):
-            ts = float(e.data.get("step", 0)) * dt_us
+        # measured step_time wall times anchor the axis when present;
+        # step-keyed where the events carry a step index, positional
+        # otherwise. Batch-journaled runs without timings keep the
+        # synthetic step * step_seconds axis.
+        st = recorder.events("step_time")
+        wall_by_step = {
+            int(e.data["step"]): e.time for e in st if "step" in e.data
+        }
+        walls = [e.time for e in st]
+        for i, e in enumerate(recorder.events("migrate_step")):
+            step = int(e.data.get("step", 0))
+            if step in wall_by_step:
+                ts = (wall_by_step[step] - t0) * 1e6
+            elif walls:
+                ts = (walls[min(i, len(walls) - 1)] - t0) * 1e6
+            else:
+                ts = float(step) * dt_us
             for counter in ("population", "backlog", "sent"):
                 if counter in e.data:
                     events.append(

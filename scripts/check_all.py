@@ -68,6 +68,11 @@ ANALYZERS = (
         ["scripts/kernelcheck.py", "--check"],
         "mpi_grid_redistribute_tpu/analysis/kernelcheck_baseline.json",
     ),
+    Analyzer(
+        "incident-demo",
+        ["scripts/incident_demo.py", "--check"],
+        "mpi_grid_redistribute_tpu/analysis/incident_demo_baseline.json",
+    ),
 )
 
 

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""End-to-end incident-observatory smoke (`make incident-demo`).
+
+Runs the whole ISSUE 17 loop in-process on the numpy backend, in
+seconds: a supervised service run with an injected latency-spike flood
+breaches the p99 SLO, the health pass fires the
+:class:`~mpi_grid_redistribute_tpu.telemetry.incident.FlightRecorder`,
+and the resulting bundles are verified end to end —
+
+* at least one debounced bundle exists (fault- and alert-triggered);
+* every ``index.json`` carries the triggering step context (``trace``
+  join key from ``telemetry/context.py``);
+* a standing rule re-confirmed across restarts stays debounced to ONE
+  bundle;
+* the frozen journal window exports to a Perfetto trace whose causal
+  flow arrows (``ph="s"/"f"``) link the cause step to the alert.
+
+Usage:
+    python scripts/incident_demo.py                    # report view
+    python scripts/incident_demo.py --check [--format=sarif]
+    python scripts/incident_demo.py --keep DIR         # keep bundles
+
+``--check`` gates the same assertions for CI (``scripts/check_all.py``
+registry row ``incident-demo``): exit 0 clean, 1 findings, 2 usage
+error; ``--format=sarif`` emits the findings as one SARIF run. The
+committed baseline (``analysis/incident_demo_baseline.json``) records
+the expected-clean contract.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import argparse  # noqa: E402
+import dataclasses  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+RULE_DOCS = {
+    "I001": "a fault-injected supervised run must leave at least one "
+    "incident bundle behind (alert- and fault-triggered)",
+    "I002": "every bundle index must carry the triggering step context "
+    "(trace join key)",
+    "I003": "a standing alert re-confirmed across restarts must stay "
+    "debounced to one bundle per rule",
+    "I004": "a bundle's frozen journal must export to a Perfetto trace "
+    "with causal flow arrows",
+}
+
+_SELF = "scripts/incident_demo.py"
+
+
+def _finding(rule, message):
+    from mpi_grid_redistribute_tpu.analysis.core import Finding
+
+    return Finding(rule=rule, path=_SELF, line=1, col=0, message=message)
+
+
+def run_demo(out_dir, verbose=True):
+    """Drive the incident loop; returns (findings, bundle entries)."""
+    from mpi_grid_redistribute_tpu.service import (
+        DriverConfig,
+        FaultPlan,
+        LatencySpikeFault,
+        RestartPolicy,
+        ServiceDriver,
+        Supervisor,
+    )
+    from mpi_grid_redistribute_tpu.telemetry import (
+        StepRecorder,
+        incident,
+        merge_journals,
+        traceview,
+    )
+
+    snaps = os.path.join(out_dir, "snaps")
+    bundles = os.path.join(out_dir, "incidents")
+    cfg = DriverConfig(
+        grid_shape=(2, 2, 2),
+        n_local=256,
+        steps=32,
+        seed=3,
+        backend="numpy",
+        snapshot_every=4,
+        snapshot_dir=snaps,
+        slo_latency_p99_s=0.25,
+        slo_window=4,
+        incident_dir=bundles,
+    )
+    rec = StepRecorder()
+    plan = FaultPlan([LatencySpikeFault(2, seconds=1.0, spikes=6)])
+
+    def factory(grid_shape=None):
+        c = cfg
+        if grid_shape is not None:
+            c = dataclasses.replace(c, grid_shape=tuple(grid_shape))
+        return ServiceDriver(c, recorder=rec, faults=plan)
+
+    sup = Supervisor(
+        factory,
+        policy=RestartPolicy(
+            max_restarts=5, backoff_base_s=0.01, backoff_cap_s=0.02,
+            shrink_after=2,
+        ),
+        recorder=rec,
+        sleep_fn=lambda s: None,
+    )
+    verdict = sup.run()
+    if verbose:
+        print(
+            f"demo: supervised run done (ok={verdict.ok} "
+            f"restarts={verdict.restarts} health={verdict.health})"
+        )
+
+    findings = []
+    entries = incident.list_bundles(bundles)
+    if verbose:
+        for e in entries:
+            print(
+                f"demo: bundle {e.get('id')} rule={e.get('rule')} "
+                f"trigger={e.get('trigger')} "
+                f"trace={(e.get('context') or {}).get('trace')}"
+            )
+    if not entries:
+        findings.append(_finding(
+            "I001", "supervised fault run produced no incident bundles"
+        ))
+        return findings, entries
+    triggers = {e.get("trigger") for e in entries}
+    if not {"alert", "fault"} <= triggers:
+        findings.append(_finding(
+            "I001",
+            f"expected both alert- and fault-triggered bundles, "
+            f"got triggers {sorted(triggers)}",
+        ))
+    for e in entries:
+        ctx = e.get("context") or {}
+        if not ctx.get("trace"):
+            findings.append(_finding(
+                "I002",
+                f"bundle {e.get('id')} index carries no trace id "
+                f"(context={ctx})",
+            ))
+    rules = [e.get("rule") for e in entries]
+    dupes = sorted({r for r in rules if rules.count(r) > 1})
+    if dupes:
+        findings.append(_finding(
+            "I003",
+            f"debounce failed: multiple bundles for rule(s) {dupes}",
+        ))
+
+    # export smoke: the alert-triggered bundle's frozen journal ->
+    # Perfetto trace; the causal flow arrows must link cause -> alert
+    target = next(
+        (e for e in entries if e.get("trigger") == "alert"), entries[0]
+    )
+    journal = os.path.join(
+        bundles, str(target.get("id")), "journal.jsonl"
+    )
+    trace_out = os.path.join(out_dir, "incident.trace.json")
+    try:
+        merged = merge_journals([journal])
+        traceview.write_trace(trace_out, merged.to_recorder())
+        with open(trace_out, "r", encoding="utf-8") as fh:
+            events = json.load(fh)["traceEvents"]
+        phases = {ev.get("ph") for ev in events}
+        if not {"s", "f"} <= phases:
+            findings.append(_finding(
+                "I004",
+                f"exported trace of {target.get('id')} has no causal "
+                f"flow arrows (phases={sorted(phases)})",
+            ))
+        elif verbose:
+            n_flow = sum(1 for ev in events if ev.get("ph") in ("s", "f"))
+            print(
+                f"demo: exported {trace_out} "
+                f"({len(events)} events, {n_flow} flow endpoints)"
+            )
+    except Exception as exc:
+        findings.append(_finding(
+            "I004",
+            f"bundle export failed: {type(exc).__name__}: {exc}",
+        ))
+    return findings, entries
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Fault-injected incident-observatory smoke: "
+        "supervised run -> flight-recorder bundles -> Perfetto export."
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="CI gate mode: findings only, exit 1 when any fire",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "sarif"),
+        default="text",
+        help="finding output format (sarif implies --check semantics)",
+    )
+    p.add_argument(
+        "--keep",
+        metavar="DIR",
+        default=None,
+        help="run in DIR and keep the bundles (default: tempdir, "
+        "removed on exit)",
+    )
+    args = p.parse_args(argv)
+
+    out_dir = args.keep or tempfile.mkdtemp(prefix="incident_demo_")
+    try:
+        findings, _ = run_demo(out_dir, verbose=args.format != "sarif")
+    finally:
+        if args.keep is None:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    if args.format == "sarif":
+        from mpi_grid_redistribute_tpu.analysis.sarif import to_sarif
+
+        json.dump(
+            to_sarif(findings, "incident-demo", RULE_DOCS),
+            sys.stdout,
+            indent=2,
+        )
+        print()
+    else:
+        for f in findings:
+            print(f"{f.rule}: {f.message}")
+        if not findings:
+            print("incident-demo: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

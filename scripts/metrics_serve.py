@@ -16,13 +16,20 @@ Thin stdlib ``http.server`` front-end over
   poller must observe health, not mutate the journal it is judging).
   HTTP 200 on OK/WARN, 503 on ALERT, so a plain liveness probe can act
   on it without parsing.
+* ``GET /incidents`` (with ``--incident-dir``) — JSON listing of the
+  flight-recorder bundles under the directory (each entry is the
+  bundle's ``index.json``; see ``telemetry/incident.py`` and
+  ``scripts/incident.py`` for inspection/export).
 
 Journal sources, combinable:
 
 * ``--journal FILE`` (repeatable) — JSONL shard(s) written by
   ``StepRecorder.to_jsonl``; several shards are pod-merged via
   ``aggregate.merge_journals`` (``--align wall|start``) and re-read on
-  every scrape, so a live run appending shards is picked up.
+  every scrape, so a live run appending shards is picked up. Parsed
+  shards are cached keyed on ``(path, mtime, size)``: a scrape storm
+  against a quiescent journal re-merges nothing, while any shard
+  growing (or appearing) invalidates the cache on the next scrape.
 * ``--demo`` — no artifacts handy: run a small in-process drift loop in
   a background thread and scrape its live recorder.
 
@@ -56,15 +63,44 @@ OPENMETRICS_CONTENT_TYPE = (
 )
 
 
+def _shard_key(paths):
+    """Cache key over the shard files: ``(path, mtime_ns, size)`` per
+    shard. Any append, truncation, replacement or late-appearing shard
+    changes the key; a quiescent journal keeps it stable."""
+    key = []
+    for p in paths:
+        try:
+            st = os.stat(p)
+            key.append((p, st.st_mtime_ns, st.st_size))
+        except OSError:
+            key.append((p, None, None))
+    return tuple(key)
+
+
 def journal_snapshotter(paths, align):
     """``(snapshot, shutdown)`` over JSONL shard files: re-reads and
-    re-merges on every call, so scrapes track a journal that is still
-    growing. Nothing to stop — ``shutdown`` is a no-op."""
+    re-merges when any shard changed since the last scrape (keyed on
+    ``(path, mtime, size)``), so scrapes track a journal that is still
+    growing without re-parsing an unchanged one on every poll. Nothing
+    to stop — ``shutdown`` is a no-op."""
     from mpi_grid_redistribute_tpu import telemetry
 
+    lock = threading.Lock()
+    cache = {"key": None, "rec": None}
+
     def snapshot():
+        # stat outside the lock (cheap, no shared state), compare under
+        # it; parse outside the lock on a miss so a slow merge does not
+        # serialize concurrent scrapes, then double-check before storing
+        key = _shard_key(paths)
+        with lock:
+            if cache["key"] == key and cache["rec"] is not None:
+                return cache["rec"]
         merged = telemetry.merge_journals(paths, align=align)
         rec = merged.to_recorder(pod_steps=len(merged.shards) > 1)
+        with lock:
+            cache["key"] = key
+            cache["rec"] = rec
         return rec
 
     def shutdown():
@@ -120,9 +156,12 @@ def demo_snapshotter(steps: int = 200):
     return snapshot, shutdown
 
 
-def make_handler(snapshot):
-    """An HTTPRequestHandler bound to a journal snapshot factory."""
+def make_handler(snapshot, incident_dir=None):
+    """An HTTPRequestHandler bound to a journal snapshot factory;
+    ``incident_dir`` additionally serves the flight-recorder bundle
+    listing on ``/incidents`` (pure file reads — no journal state)."""
     from mpi_grid_redistribute_tpu import telemetry
+    from mpi_grid_redistribute_tpu.telemetry import incident as incident_lib
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def _send(self, code, ctype, body: bytes):
@@ -149,11 +188,21 @@ def make_handler(snapshot):
                 )
                 code = 503 if verdict["status"] == "ALERT" else 200
                 self._send(code, "application/json; charset=utf-8", body)
+            elif path == "/incidents" and incident_dir is not None:
+                listing = incident_lib.list_bundles(incident_dir)
+                body = (
+                    json.dumps(
+                        {"dir": incident_dir, "incidents": listing},
+                        sort_keys=True,
+                    )
+                    + "\n"
+                ).encode("utf-8")
+                self._send(200, "application/json; charset=utf-8", body)
             else:
                 self._send(
                     404,
                     "text/plain; charset=utf-8",
-                    b"try /metrics or /healthz\n",
+                    b"try /metrics, /healthz or /incidents\n",
                 )
 
         def log_message(self, fmt, *args):
@@ -185,6 +234,12 @@ def main(argv=None) -> int:
         "--demo",
         action="store_true",
         help="serve a live in-process drift-loop journal",
+    )
+    p.add_argument(
+        "--incident-dir",
+        metavar="DIR",
+        help="flight-recorder bundle root; enables GET /incidents "
+        "(see telemetry/incident.py)",
     )
     p.add_argument("--port", type=int, default=9100,
                    help="0 = ephemeral (bound port is printed)")
@@ -224,10 +279,12 @@ def main(argv=None) -> int:
         return 0
 
     server = http.server.ThreadingHTTPServer(
-        (args.host, args.port), make_handler(snapshot)
+        (args.host, args.port),
+        make_handler(snapshot, incident_dir=args.incident_dir),
     )
     host, port = server.server_address[:2]
-    print(f"serving http://{host}:{port}/metrics and /healthz "
+    extra = " and /incidents" if args.incident_dir else ""
+    print(f"serving http://{host}:{port}/metrics, /healthz{extra} "
           "(Ctrl-C to stop)", flush=True)
 
     def _on_sigterm(signum, frame):

@@ -23,6 +23,7 @@ Five contracts, each tested against hand math or a real scrape:
 """
 
 import ast
+import importlib.util
 import json
 import math
 import os
@@ -30,6 +31,7 @@ import re
 import subprocess
 import sys
 import threading
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -505,6 +507,94 @@ def test_metrics_serve_scrapes_over_http(tmp_path):
         proc.wait(timeout=10)
 
 
+def test_journal_snapshotter_caches_unchanged_shards(tmp_path):
+    """Scrape-storm contract (ISSUE 17): an unchanged shard set must not
+    be re-parsed — the snapshotter caches the merged recorder keyed on
+    every shard's (path, mtime, size) and invalidates on any growth."""
+    spec = importlib.util.spec_from_file_location("_serve_mod", SERVE)
+    serve = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve)
+
+    rec = StepRecorder(host="h", pid=1)
+    rec.record("migrate_step", step=0, sent=1, received=1, backlog=0,
+               dropped_recv=0, population=8)
+    p = tmp_path / "shard.jsonl"
+    rec.to_jsonl(str(p))
+    snapshot, shutdown = serve.journal_snapshotter([str(p)], "wall")
+    a = snapshot()
+    assert a.counts() == {"migrate_step": 1}
+    assert snapshot() is a          # quiescent journal: cache hit
+    # the shard growing (size changes) invalidates on the next scrape
+    rec.record("migrate_step", step=1, sent=1, received=1, backlog=0,
+               dropped_recv=0, population=8)
+    rec.to_jsonl(str(p))
+    b = snapshot()
+    assert b is not a
+    assert b.counts() == {"migrate_step": 2}
+    shutdown()
+
+
+def test_incidents_endpoint_and_healthz_503(tmp_path):
+    """The ISSUE 17 HTTP surface: a journal whose health verdict ALERTs
+    must 503 on /healthz, and --incident-dir serves the flight-recorder
+    bundle listing on /incidents (a 404 names all three endpoints)."""
+    from mpi_grid_redistribute_tpu.telemetry import incident as incident_lib
+
+    rec = StepRecorder(host="h", pid=1)
+    for s in range(8):
+        rec.record("migrate_step", step=s, sent=1, received=1,
+                   backlog=100 * (s + 1), dropped_recv=0, population=64)
+    bundles = tmp_path / "incidents"
+    fr = incident_lib.FlightRecorder(rec, str(bundles), clock=lambda: 123.0)
+    assert fr.capture(
+        rule="backlog_growth", reason="monotone backlog", trigger="alert"
+    ) is not None
+    shard = tmp_path / "shard.jsonl"
+    rec.to_jsonl(str(shard))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, SERVE, "--journal", str(shard),
+         "--incident-dir", str(bundles), "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO_ROOT, env=env,
+    )
+    watchdog = threading.Timer(120, proc.kill)
+    watchdog.start()
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"http://([\d.]+):(\d+)/metrics", line)
+        assert m, (line, proc.poll(), proc.stderr.read() if proc.poll()
+                   is not None else "")
+        base = f"http://{m.group(1)}:{m.group(2)}"
+        with urllib.request.urlopen(base + "/incidents", timeout=30) as r:
+            assert r.status == 200
+            doc = json.loads(r.read().decode("utf-8"))
+        assert [e["id"] for e in doc["incidents"]] == [
+            "incident-0001-backlog_growth"
+        ]
+        entry = doc["incidents"][0]
+        assert entry["rule"] == "backlog_growth"
+        assert entry["captured_at"] == 123.0
+        # the monotone backlog ALERTs: the probe sees 503, not 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=30)
+        assert ei.value.code == 503
+        verdict = json.loads(ei.value.read().decode("utf-8"))
+        assert verdict["status"] == "ALERT"
+        # /metrics still renders well-formed OpenMetrics alongside
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            assert r.read().decode("utf-8").splitlines()[-1] == "# EOF"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=30)
+        assert ei.value.code == 404
+        assert b"/incidents" in ei.value.read()
+    finally:
+        watchdog.cancel()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_healthz_evaluate_is_read_only():
     rec = StepRecorder(host="h", pid=1)
     for s in range(8):
@@ -525,16 +615,19 @@ def test_healthz_evaluate_is_read_only():
 
 
 def test_scrape_path_loads_without_jax():
-    """metrics.py/aggregate.py must be importable with jax absent from
-    sys.modules — the runtime half of the G007 contract (a scrape can
-    never stall on device work it cannot even reach)."""
+    """metrics.py/aggregate.py — and the ISSUE 17 capture path
+    (context.py, incident.py) — must be importable with jax absent from
+    sys.modules — the runtime half of the G007 contract (a scrape or an
+    incident capture can never stall on device work it cannot even
+    reach)."""
     code = (
         "import importlib.util, os, sys, types\n"
         f"tel = {TELEMETRY!r}\n"
         "pkg = types.ModuleType('scrape_pkg')\n"
         "pkg.__path__ = [tel]\n"
         "sys.modules['scrape_pkg'] = pkg\n"
-        "for name in ('recorder', 'metrics', 'aggregate'):\n"
+        "for name in ('context', 'recorder', 'metrics', 'aggregate',\n"
+        "             'incident'):\n"
         "    spec = importlib.util.spec_from_file_location(\n"
         "        'scrape_pkg.' + name, os.path.join(tel, name + '.py'))\n"
         "    mod = importlib.util.module_from_spec(spec)\n"
@@ -550,7 +643,7 @@ def test_scrape_path_loads_without_jax():
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "pure"
     # static half: no jax import statement in the module sources
-    for name in ("metrics.py", "aggregate.py"):
+    for name in ("metrics.py", "aggregate.py", "context.py", "incident.py"):
         with open(os.path.join(TELEMETRY, name), encoding="utf-8") as fh:
             src = fh.read()
         assert re.search(r"#\s*gridlint:\s*scrape-path", src), name
