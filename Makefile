@@ -4,7 +4,8 @@ PY ?= python
 	soak soak-smoke rebalance-smoke service-bench progcheck \
 	progcheck-baseline shardcheck shardcheck-baseline check \
 	attribution attribution-check racecheck racecheck-baseline \
-	kernelcheck kernelcheck-baseline incident-demo
+	kernelcheck kernelcheck-baseline incident-demo storecheck \
+	grid-top history
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -86,13 +87,14 @@ service-bench:
 # every analyzer family in --check text mode, driven off the single
 # ANALYZERS registry in scripts/check_all.py (gridlint G, progcheck J,
 # shardcheck S, attribution, racecheck T, kernelcheck K, incident-demo
-# I) — adding a family is one registry row, not a Makefile edit. Exit 0 = clean or
+# I, storecheck ST) — adding a family is one registry row, not a
+# Makefile edit. Exit 0 = clean or
 # fully baselined; 1 = new findings or stale baseline entries; 2 =
 # usage/parse error. See mpi_grid_redistribute_tpu/analysis/.
 lint:
 	$(PY) scripts/check_all.py --lint
 
-# one-shot CI umbrella: the same seven analyzers/gates, SARIF runs merged
+# one-shot CI umbrella: the same eight analyzers/gates, SARIF runs merged
 # into a single analysis_merged.sarif for one code-scanning upload.
 # Per-analyzer wall-time is printed so lint growth stays visible;
 # `--analyzers NAME[,NAME]` subsets the registry for fast local loops.
@@ -159,6 +161,33 @@ racecheck-baseline:
 # telemetry/incident.py and scripts/incident.py.
 incident-demo:
 	JAX_PLATFORMS=cpu $(PY) scripts/incident_demo.py --check
+
+# journal-store integrity gate (ISSUE 18, also inside `make check`):
+# build a demo store through rotation + compaction + retention on a
+# deliberately tiny wrapping recorder ring, then gate ST01-ST07 —
+# segment sha256s vs the manifest, the count-conservation ledger, seq
+# ordering, rotation/retention bounds, compaction exactness, and the
+# headline claim: metrics.from_journal over the drained+compacted
+# store equals the live recorder's all-time counts after eviction.
+# Point it at a real store root to check a run's artifacts:
+#   python scripts/storecheck.py /path/to/store
+storecheck:
+	JAX_PLATFORMS=cpu $(PY) scripts/storecheck.py --check
+
+# one-shot dashboard snapshot over the storecheck demo store (CI-safe;
+# live mode: scripts/grid_top.py --store DIR or --url http://host:port)
+grid-top:
+	JAX_PLATFORMS=cpu $(PY) scripts/storecheck.py --keep .grid_top_demo \
+		> /dev/null && \
+	JAX_PLATFORMS=cpu $(PY) scripts/grid_top.py \
+		--store .grid_top_demo/store --once; \
+	rm -rf .grid_top_demo
+
+# run-index view: BENCH_r*.json perf trajectory (+ store runs via
+# --stores DIR); `--check capture.json` gates a fresh capture against
+# the whole indexed history through regress.classify_capture
+history:
+	JAX_PLATFORMS=cpu $(PY) scripts/history.py
 
 # kernelcheck alone: capture every registered Pallas kernel's
 # pallas_call anatomy via jax.eval_shape (no execution) and gate

@@ -142,6 +142,20 @@ class DriverConfig:
     # its debounce/counter state survives supervisor restarts.
     incident_dir: Optional[str] = None
     incident_debounce_s: float = 60.0  # per-rule bundle debounce window
+    # telemetry history plane (ISSUE 18): when set, a
+    # telemetry.store.JournalStore rooted here is drained at every
+    # chunk/health boundary (and once more at close()) — the bounded
+    # recorder ring becomes durable checksummed segments with the
+    # recorder's exact all-time counts in the manifest. Drains happen
+    # only at boundaries, never inside the resident macro-step (G009),
+    # and a restarted driver re-opens the same root and resumes from
+    # the manifest's drain watermark (no duplicate events). Inspect
+    # with scripts/grid_top.py / scripts/storecheck.py, serve with
+    # scripts/metrics_serve.py --store.
+    store_dir: Optional[str] = None
+    store_segment_events: int = 4096   # events per segment before rotation
+    store_retain_bytes: int = 64 * 1024 * 1024  # closed-segment disk budget
+    store_compact_after: int = 2       # newest raw segments kept uncompacted
     # multi-window error-budget burn-rate alerting over the same SLO
     # thresholds (telemetry.health.burn_rate_*): pure alerting — burn
     # ALERTs capture bundles and flip /healthz but do not raise
@@ -210,6 +224,7 @@ class ServiceDriver:
         self._install_slo_rules()
         self._install_rebalance_rule()
         self._flight = self._install_flight_recorder()
+        self._store = self._install_store()
 
     def _install_slo_rules(self) -> None:
         # the monitor is SHARED across supervisor restarts, so install
@@ -253,6 +268,21 @@ class ServiceDriver:
                     slow_window=slow,
                 )
             )
+
+    def _install_store(self):
+        # one JournalStore per store root; a supervisor-restarted driver
+        # re-opens the same root and the manifest's drain watermark
+        # (seq against the SHARED recorder) keeps drains exactly-once
+        if not self.cfg.store_dir:
+            return None
+        from mpi_grid_redistribute_tpu.telemetry.store import JournalStore
+
+        return JournalStore(
+            self.cfg.store_dir,
+            segment_events=self.cfg.store_segment_events,
+            retain_bytes=self.cfg.store_retain_bytes,
+            compact_after=self.cfg.store_compact_after,
+        )
 
     def _install_flight_recorder(self):
         # idempotent per shared recorder (telemetry.incident.install):
@@ -906,14 +936,22 @@ class ServiceDriver:
         # the fault provoked may raise (SLOBreachError) out of the check
         if self._flight is not None:
             self._flight.scan_faults()
-        if cfg.snapshot_every and self.step % cfg.snapshot_every == 0:
-            self._materialize_state()
-            path = self.snapshot()
-            self.faults.after_snapshot(self, path)
-            self._health_check()
-        elif cfg.health_every and self.step % cfg.health_every == 0:
-            self._materialize_state()
-            self._health_check()
+        try:
+            if cfg.snapshot_every and self.step % cfg.snapshot_every == 0:
+                self._materialize_state()
+                path = self.snapshot()
+                self.faults.after_snapshot(self, path)
+                self._health_check()
+            elif cfg.health_every and self.step % cfg.health_every == 0:
+                self._materialize_state()
+                self._health_check()
+        finally:
+            # drain the ring into the durable store AFTER the health
+            # pass (its alert events make this boundary's segment) and
+            # even when the check raised SLOBreachError — the breach
+            # evidence must be on disk before the restart tears us down
+            if self._store is not None:
+                self._store.drain(self.recorder)
 
     def _run_chunk_eager(self, n: int, fire_faults: bool = True) -> None:
         """Advance ``n`` steps through the eager per-step engine path
@@ -1101,6 +1139,10 @@ class ServiceDriver:
             # a fault that crashed the attempt before the next boundary
             # still leaves its incident bundle behind
             self._flight.scan_faults()
+        if self._store is not None:
+            # final drain + rotate/compact/retention BEFORE the journal
+            # export, so the exported shard includes the last store_drain
+            self._store.close(self.recorder)
         self.export_journal()
 
     def abandon(self) -> Optional[str]:
@@ -1154,6 +1196,12 @@ def main(argv=None) -> int:
     p.add_argument("--snapshot-every", type=int, default=0)
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument("--journal-dir", default=None)
+    p.add_argument(
+        "--store-dir", default=None, metavar="DIR",
+        help="durable journal store root (telemetry/store.py): the "
+             "recorder ring is drained here at every chunk/health "
+             "boundary; watch with scripts/grid_top.py --store DIR",
+    )
     p.add_argument("--keep-snapshots", type=int, default=4)
     p.add_argument("--sync-snapshots", action="store_true")
     p.add_argument("--watchdog", type=float, default=0.0)
@@ -1258,6 +1306,7 @@ def main(argv=None) -> int:
         keep_snapshots=args.keep_snapshots,
         snapshot_async=not args.sync_snapshots,
         journal_dir=args.journal_dir,
+        store_dir=args.store_dir,
         watchdog_s=args.watchdog,
         step_sleep=args.step_sleep,
         chunk=args.chunk,

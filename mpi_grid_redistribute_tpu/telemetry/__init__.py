@@ -86,6 +86,26 @@ alerts actionable:
   rules (``burn_rate_latency`` / ``burn_rate_dropped``) and isolates
   callback exceptions (``callback_error`` events).
 
+The telemetry history plane (ISSUE 18) makes the journal durable and
+queryable:
+
+* :mod:`.store` — :class:`~.store.JournalStore`, a segmented
+  append-only store the service driver drains the recorder ring into
+  at every chunk/health boundary: size/step rotation, sha256-manifest
+  integrity (checkpoint staged-rename publishes), age/byte retention,
+  and compaction of old raw segments into exact ``store_window``
+  summaries (per-kind counts + quantile sketches on the live Histogram
+  edges) — bounded disk with byte-exact all-time counts after ring
+  eviction (:class:`~.store.StoreReader`; ``scripts/storecheck.py``
+  gates ST01–ST07).
+* :mod:`.query` — the jax-free query plane over any journal source
+  (live recorder, merged shards, store): kind/step/trace/host/ctx
+  filters, windowed aggregations (rate, p50/p99, EMA), group-bys —
+  served as ``GET /query`` plus the cursor-resumable ``GET /events``
+  long-poll on ``scripts/metrics_serve.py``; ``scripts/grid_top.py``
+  is the live terminal dashboard and ``scripts/history.py`` the
+  cross-run index.
+
 Event schema and metric families: ``telemetry/SCHEMA.md``.
 """
 
@@ -165,4 +185,19 @@ from mpi_grid_redistribute_tpu.telemetry.profiler import (  # noqa: F401
 from mpi_grid_redistribute_tpu.telemetry.tsan import (  # noqa: F401
     ThreadAccess,
     ThreadAccessTracer,
+)
+from mpi_grid_redistribute_tpu.telemetry.store import (  # noqa: F401
+    JournalStore,
+    StoreCorruptError,
+    StoreReader,
+    list_stores,
+)
+from mpi_grid_redistribute_tpu.telemetry.query import (  # noqa: F401
+    QueryError,
+    events_page,
+    filter_rows,
+    group_rows,
+    rows_of,
+    run_query,
+    window_aggregate,
 )

@@ -73,6 +73,11 @@ ANALYZERS = (
         ["scripts/incident_demo.py", "--check"],
         "mpi_grid_redistribute_tpu/analysis/incident_demo_baseline.json",
     ),
+    Analyzer(
+        "storecheck",
+        ["scripts/storecheck.py", "--check"],
+        "mpi_grid_redistribute_tpu/analysis/storecheck_baseline.json",
+    ),
 )
 
 

@@ -20,6 +20,18 @@ Thin stdlib ``http.server`` front-end over
   flight-recorder bundles under the directory (each entry is the
   bundle's ``index.json``; see ``telemetry/incident.py`` and
   ``scripts/incident.py`` for inspection/export).
+* ``GET /query`` — the telemetry query plane
+  (:mod:`...telemetry.query`): filter by ``kind``/``step_min``/
+  ``step_max``/``trace``/``host``/``pid``/``since``/``until``/
+  ``ctx.<field>``, shape with ``agg=<op>`` windowed series or
+  ``by=<key>`` grouped counts (grammar in telemetry/SCHEMA.md). Bad
+  parameters are HTTP 400 with the parse error in the body.
+* ``GET /events`` — cursor-resumable event stream over the same
+  source. The cursor is the ``host:pid:seq`` envelope triple (the pod
+  merge's total order); pass the previous reply's ``cursor`` back to
+  resume exactly where it left off, ``limit`` to bound the page and
+  ``timeout_s`` to long-poll until new events arrive (re-snapshots the
+  source every 0.2 s while waiting).
 
 Journal sources, combinable:
 
@@ -30,6 +42,11 @@ Journal sources, combinable:
   shards are cached keyed on ``(path, mtime, size)``: a scrape storm
   against a quiescent journal re-merges nothing, while any shard
   growing (or appearing) invalidates the cache on the next scrape.
+* ``--store DIR`` — a durable ``telemetry.store`` journal-store root
+  (``MANIFEST.json`` + segments). Re-read when the manifest changes, so
+  a live driver draining into the store is tracked scrape to scrape;
+  counters stay the manifest's exact all-time counts even after
+  retention and compaction.
 * ``--demo`` — no artifacts handy: run a small in-process drift loop in
   a background thread and scrape its live recorder.
 
@@ -109,6 +126,55 @@ def journal_snapshotter(paths, align):
     return snapshot, shutdown
 
 
+def store_snapshotter(store_dir):
+    """``(snapshot, query_snapshot, shutdown)`` over a durable
+    ``telemetry.store`` root. ``snapshot`` returns a replayed
+    ``StepRecorder`` with its all-time counters pinned to the
+    manifest's exact totals (what ``/metrics`` and ``/healthz``
+    consume); ``query_snapshot`` returns the ``StoreReader`` itself so
+    ``/query`` and ``/events`` see compacted ``store_window`` rows
+    first-class (quantiles over summaries stay exact). Both are cached
+    keyed on the manifest's ``(mtime_ns, size)`` — the store's writer
+    publishes the manifest atomically, so a changed key is a complete
+    new store state, never a torn one."""
+    from mpi_grid_redistribute_tpu.telemetry import store as store_lib
+
+    manifest_path = os.path.join(store_dir, "MANIFEST.json")
+    lock = threading.Lock()
+    cache = {"key": None, "reader": None, "rec": None}
+
+    def _key():
+        try:
+            st = os.stat(manifest_path)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _refresh():
+        key = _key()
+        with lock:
+            if cache["key"] == key and cache["reader"] is not None:
+                return cache["reader"], cache["rec"]
+        reader = store_lib.StoreReader(store_dir)
+        rec = reader.to_recorder()
+        with lock:
+            cache["key"] = key
+            cache["reader"] = reader
+            cache["rec"] = rec
+        return reader, rec
+
+    def snapshot():
+        return _refresh()[1]
+
+    def query_snapshot():
+        return _refresh()[0]
+
+    def shutdown():
+        return None
+
+    return snapshot, query_snapshot, shutdown
+
+
 def demo_snapshotter(steps: int = 200):
     """``(snapshot, shutdown)`` over a small redistribute loop run in a
     background thread; scrapes snapshot its recorder live. Uses the
@@ -156,12 +222,20 @@ def demo_snapshotter(steps: int = 200):
     return snapshot, shutdown
 
 
-def make_handler(snapshot, incident_dir=None):
+def make_handler(snapshot, incident_dir=None, query_source=None):
     """An HTTPRequestHandler bound to a journal snapshot factory;
     ``incident_dir`` additionally serves the flight-recorder bundle
-    listing on ``/incidents`` (pure file reads — no journal state)."""
+    listing on ``/incidents`` (pure file reads — no journal state).
+    ``query_source`` overrides the source ``/query``/``/events`` read
+    (the store mode passes the ``StoreReader`` here so compacted
+    summary rows stay visible); defaults to ``snapshot``."""
+    import urllib.parse
+
     from mpi_grid_redistribute_tpu import telemetry
     from mpi_grid_redistribute_tpu.telemetry import incident as incident_lib
+    from mpi_grid_redistribute_tpu.telemetry import query as query_lib
+
+    events_source = query_source if query_source is not None else snapshot
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def _send(self, code, ctype, body: bytes):
@@ -170,6 +244,20 @@ def make_handler(snapshot, incident_dir=None):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_json(self, code, doc):
+            body = (json.dumps(doc, sort_keys=True) + "\n").encode("utf-8")
+            self._send(code, "application/json; charset=utf-8", body)
+
+        def _params(self):
+            qs = urllib.parse.urlsplit(self.path).query
+            # last value wins, matching the flat-string grammar
+            return {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(
+                    qs, keep_blank_values=True
+                ).items()
+            }
 
         def do_GET(self):  # noqa: N802 (http.server API)
             path = self.path.split("?", 1)[0]
@@ -198,11 +286,45 @@ def make_handler(snapshot, incident_dir=None):
                     + "\n"
                 ).encode("utf-8")
                 self._send(200, "application/json; charset=utf-8", body)
+            elif path == "/query":
+                try:
+                    reply = query_lib.run_query(
+                        events_source(), self._params()
+                    )
+                except query_lib.QueryError as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                self._send_json(200, reply)
+            elif path == "/events":
+                params = self._params()
+                try:
+                    cursor = params.get("cursor") or None
+                    limit = int(params.get("limit", "256"))
+                    timeout_s = float(params.get("timeout_s", "0"))
+                    kind = params.get("kind") or None
+                    deadline = time.monotonic() + min(timeout_s, 60.0)
+                    while True:
+                        rows = query_lib.rows_of(events_source())
+                        if kind:
+                            rows = query_lib.filter_rows(rows, kind=kind)
+                        page = query_lib.events_page(
+                            rows, cursor=cursor, limit=limit
+                        )
+                        if page["events"] or time.monotonic() >= deadline:
+                            break
+                        # long-poll: re-snapshot until new events land
+                        # or the (capped) timeout expires
+                        time.sleep(0.2)
+                except (query_lib.QueryError, ValueError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                self._send_json(200, page)
             else:
                 self._send(
                     404,
                     "text/plain; charset=utf-8",
-                    b"try /metrics, /healthz or /incidents\n",
+                    b"try /metrics, /healthz, /incidents, /query or "
+                    b"/events\n",
                 )
 
         def log_message(self, fmt, *args):
@@ -231,6 +353,12 @@ def main(argv=None) -> int:
         help="multi-shard clock alignment (see aggregate.merge_journals)",
     )
     p.add_argument(
+        "--store",
+        metavar="DIR",
+        help="durable journal-store root (telemetry/store.py); re-read "
+        "when its MANIFEST.json changes",
+    )
+    p.add_argument(
         "--demo",
         action="store_true",
         help="serve a live in-process drift-loop journal",
@@ -252,15 +380,21 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
-    if not args.journal and not args.demo:
-        p.error("need --journal FILE (repeatable) or --demo")
-    if args.journal and args.demo:
-        p.error("--journal and --demo are mutually exclusive")
+    sources = sum(
+        (bool(args.journal), bool(args.store), bool(args.demo))
+    )
+    if sources == 0:
+        p.error("need --journal FILE (repeatable), --store DIR or --demo")
+    if sources > 1:
+        p.error("--journal, --store and --demo are mutually exclusive")
 
     from mpi_grid_redistribute_tpu import telemetry
 
+    query_source = None
     if args.journal:
         snapshot, shutdown = journal_snapshotter(args.journal, args.align)
+    elif args.store:
+        snapshot, query_source, shutdown = store_snapshotter(args.store)
     else:
         snapshot, shutdown = demo_snapshotter()
 
@@ -280,12 +414,16 @@ def main(argv=None) -> int:
 
     server = http.server.ThreadingHTTPServer(
         (args.host, args.port),
-        make_handler(snapshot, incident_dir=args.incident_dir),
+        make_handler(
+            snapshot,
+            incident_dir=args.incident_dir,
+            query_source=query_source,
+        ),
     )
     host, port = server.server_address[:2]
     extra = " and /incidents" if args.incident_dir else ""
-    print(f"serving http://{host}:{port}/metrics, /healthz{extra} "
-          "(Ctrl-C to stop)", flush=True)
+    print(f"serving http://{host}:{port}/metrics, /healthz, /query, "
+          f"/events{extra} (Ctrl-C to stop)", flush=True)
 
     def _on_sigterm(signum, frame):
         # route SIGTERM through the KeyboardInterrupt path below so the

@@ -649,7 +649,9 @@ def test_g007_metrics_plane_is_marked_and_clean():
     from mpi_grid_redistribute_tpu.analysis.rules_scrape import _MARKER_RE
 
     tel = os.path.join(PACKAGE, "telemetry")
-    for name in ("metrics.py", "aggregate.py"):
+    # the ISSUE 18 history plane (store.py, query.py) joins the original
+    # metrics plane under the same opt-in purity contract
+    for name in ("metrics.py", "aggregate.py", "store.py", "query.py"):
         with open(os.path.join(tel, name), encoding="utf-8") as fh:
             src = fh.read()
         assert _MARKER_RE.search(src), f"{name} lost its scrape-path marker"

@@ -615,11 +615,11 @@ def test_healthz_evaluate_is_read_only():
 
 
 def test_scrape_path_loads_without_jax():
-    """metrics.py/aggregate.py — and the ISSUE 17 capture path
-    (context.py, incident.py) — must be importable with jax absent from
-    sys.modules — the runtime half of the G007 contract (a scrape or an
-    incident capture can never stall on device work it cannot even
-    reach)."""
+    """metrics.py/aggregate.py — the ISSUE 17 capture path (context.py,
+    incident.py) and the ISSUE 18 history plane (store.py, query.py) —
+    must be importable with jax absent from sys.modules — the runtime
+    half of the G007 contract (a scrape, an incident capture or a store
+    drain can never stall on device work it cannot even reach)."""
     code = (
         "import importlib.util, os, sys, types\n"
         f"tel = {TELEMETRY!r}\n"
@@ -627,7 +627,7 @@ def test_scrape_path_loads_without_jax():
         "pkg.__path__ = [tel]\n"
         "sys.modules['scrape_pkg'] = pkg\n"
         "for name in ('context', 'recorder', 'metrics', 'aggregate',\n"
-        "             'incident'):\n"
+        "             'incident', 'store', 'query'):\n"
         "    spec = importlib.util.spec_from_file_location(\n"
         "        'scrape_pkg.' + name, os.path.join(tel, name + '.py'))\n"
         "    mod = importlib.util.module_from_spec(spec)\n"
@@ -643,7 +643,8 @@ def test_scrape_path_loads_without_jax():
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "pure"
     # static half: no jax import statement in the module sources
-    for name in ("metrics.py", "aggregate.py", "context.py", "incident.py"):
+    for name in ("metrics.py", "aggregate.py", "context.py", "incident.py",
+                 "store.py", "query.py"):
         with open(os.path.join(TELEMETRY, name), encoding="utf-8") as fh:
             src = fh.read()
         assert re.search(r"#\s*gridlint:\s*scrape-path", src), name
