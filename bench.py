@@ -293,6 +293,20 @@ def main() -> None:
 
         service = config10_service.run()
 
+    # hierarchical two-level wire capture (bench/config4_drift
+    # .hierarchical_wire_capture, ISSUE 19): the same ~2% drift workload
+    # through the two-level engine on a virtual 2x(1,2,2)-pod split —
+    # the per-domain schedule split lands top-level so regress.py's
+    # auto-armed LOWER gates (exchange_dcn_bytes_per_step /
+    # exchange_ici_bytes_per_step) read it from this capture too
+    hier = None
+    if os.environ.get("BENCH_HIER", "1") != "0":
+        from mpi_grid_redistribute_tpu.bench import config4_drift
+
+        hier = config4_drift.hierarchical_wire_capture(
+            (2, 2, 2), (2, 1, 1), migration
+        )
+
     print(
         json.dumps(
             {
@@ -335,6 +349,13 @@ def main() -> None:
                 "soak": soak,
                 "rebalance": rebalance,
                 "service": service,
+                "hier": hier,
+                "exchange_dcn_bytes_per_step": (
+                    hier.get("dcn_bytes_per_step") if hier else None
+                ),
+                "exchange_ici_bytes_per_step": (
+                    hier.get("ici_bytes_per_step") if hier else None
+                ),
                 # environment fingerprint (telemetry.regress): the
                 # classifier flags cross-capture deltas whose machine
                 # changed out from under them

@@ -249,6 +249,11 @@ _VRANK_GRID = (2, 2, 4)  # 16 ranks > 8 devices -> vmapped vranks
 _N_LOCAL = 32
 _CAPACITY = 16
 _MOVER_CAP = 4
+# Two-pod decompositions for the hierarchical engine: the sharded grid
+# splits into 2 pods of (1, 2, 2) along x, the vrank grid into 2 pods
+# of (2, 2, 2) along z — both give the S004 DCN column a live axis.
+_DCN_SHARDED = (2, 1, 1)
+_DCN_VRANK = (1, 1, 2)
 
 
 def _require_devices(n: int = 8):
@@ -265,7 +270,7 @@ def _require_devices(n: int = 8):
     return devs
 
 
-def _mk_rd(engine: str, topology: str, edges=None):
+def _mk_rd(engine: str, topology: str, edges=None, dcn_shape=None):
     from mpi_grid_redistribute_tpu import api
     from mpi_grid_redistribute_tpu.domain import ProcessGrid
     from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
@@ -277,6 +282,7 @@ def _mk_rd(engine: str, topology: str, edges=None):
     else:
         grid = ProcessGrid(_VRANK_GRID)
         mesh = None
+    mover = _MOVER_CAP if engine in ("sparse", "neighbor", "hierarchical") else None
     return api.GridRedistribute(
         grid=grid,
         lo=(0.0,) * 3,
@@ -285,12 +291,14 @@ def _mk_rd(engine: str, topology: str, edges=None):
         engine=engine,
         mesh=mesh,
         capacity=_CAPACITY,
-        mover_cap=_MOVER_CAP if engine in ("sparse", "neighbor") else None,
+        mover_cap=mover,
+        dcn_shape=dcn_shape,
+        cross_cap=_MOVER_CAP if engine == "hierarchical" else None,
         edges=edges,
     )
 
 
-def _canonical_build(engine: str, topology: str, edges_fn=None):
+def _canonical_build(engine: str, topology: str, edges_fn=None, dcn_shape=None):
     """Builder for one canonical-exchange program: the exact jitted
     engine ``GridRedistribute.engine_fn`` resolves — what
     ``redistribute()`` dispatches — traced on template arrays."""
@@ -299,13 +307,52 @@ def _canonical_build(engine: str, topology: str, edges_fn=None):
         import jax.numpy as jnp
 
         edges = edges_fn() if edges_fn is not None else None
-        rd = _mk_rd(engine, topology, edges=edges)
+        rd = _mk_rd(engine, topology, edges=edges, dcn_shape=dcn_shape)
         R = rd.nranks
         pos = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
         ids = jnp.zeros((R * _N_LOCAL,), jnp.int32)
         count = jnp.full((R,), _N_LOCAL, jnp.int32)
         fn, _cap, _out_cap = rd.engine_fn(pos, ids)
         return fn, (pos, count, ids)
+
+    return build
+
+
+def _sparse_pods_build():
+    """Builder for the flat sparse engine traced on the EXPANDED two-pod
+    mesh — the S004 comparison denominator for the hierarchical DCN
+    gate. Same grid, capacities and mover cap as the canonical
+    hierarchical program, but the wire is the flat sparse all_to_all
+    whose every hop crosses the ``dcn_x`` axis, so its collective bytes
+    bill entirely to the DCN column."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+        from mpi_grid_redistribute_tpu.parallel import exchange
+        from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+        _require_devices()
+        grid = ProcessGrid(_SHARDED_GRID)
+        hm = mesh_lib.HierarchicalMesh(grid, _DCN_SHARDED)
+        emesh = hm.build_mesh()
+        domain = Domain(0.0, 1.0, periodic=True)
+        R = grid.nranks
+        fn = exchange.build_redistribute_count_driven(
+            emesh,
+            domain,
+            grid,
+            _N_LOCAL,
+            _N_LOCAL,
+            _MOVER_CAP,
+            3,
+            engine="sparse",
+            axes=hm.axis_names,
+        )
+        fused = jnp.zeros((4, R * _N_LOCAL), jnp.int32)
+        count = jnp.full((R,), _N_LOCAL, jnp.int32)
+        return fn, (fused, count)
 
     return build
 
@@ -464,6 +511,40 @@ def _register_defaults() -> None:
                     tags=("canonical",),
                 )
             )
+    for topology, dcn in (("sharded", _DCN_SHARDED), ("vranks", _DCN_VRANK)):
+        register_program(
+            ProgramSpec(
+                name=f"canonical_hierarchical_{topology}",
+                build=_canonical_build(
+                    "hierarchical", topology, dcn_shape=dcn
+                ),
+                description=(
+                    "GridRedistribute.engine_fn('hierarchical') on the "
+                    f"{topology} CPU mesh split into pods by dcn {dcn} "
+                    "(intra-pod neighbor ppermute + staged per-(pod,pod) "
+                    "DCN hop)"
+                ),
+                engine="hierarchical",
+                topology=topology,
+                capacity=_CAPACITY,
+                mover_cap=_MOVER_CAP,
+                tags=("canonical", "hierarchical"),
+            )
+        )
+    register_program(
+        ProgramSpec(
+            name="canonical_sparse_pods",
+            build=_sparse_pods_build(),
+            description="flat sparse engine on the EXPANDED two-pod "
+            "sharded mesh — the DCN-ratio comparison denominator for "
+            "the hierarchical S004 gate",
+            engine="sparse",
+            topology="sharded",
+            capacity=_N_LOCAL,
+            mover_cap=_MOVER_CAP,
+            tags=("hierarchical", "comparison"),
+        )
+    )
     register_program(
         ProgramSpec(
             name="migrate_sparse_vranks",

@@ -11,10 +11,12 @@ will gate against.
 
 The domain rollup is by axis-name convention: an axis named like a
 cross-pod link (``dcn``, ``pod``/``pods``, ``slice``/``slices``,
-``wan``) bills to DCN; everything else is ICI. Today every registered
-mesh is single-pod, so the DCN column is structurally zero — the
-mechanism exists so the hierarchical-mesh PR changes a TABLE, not the
-analyzer.
+``wan``) bills to DCN — including ``_``-joined expanded names like the
+HierarchicalMesh's ``dcn_x`` — and everything else is ICI. Since
+ISSUE 19 the registry carries multi-pod hierarchical programs, so the
+DCN column is live: the staged cross hop's bytes land there and the
+ratio against the flat sparse engine's cross-pod bytes is gated
+(``check_dcn_ratio``, wired into ``make shardcheck``).
 """
 
 from __future__ import annotations
@@ -143,7 +145,16 @@ DCN_AXIS_TOKENS = frozenset({"dcn", "pod", "pods", "slice", "slices", "wan"})
 
 
 def axis_domain(axis: str) -> str:
-    return DCN_DOMAIN if str(axis).lower() in DCN_AXIS_TOKENS else ICI_DOMAIN
+    """Domain of one mesh axis by naming convention. Token-split on
+    ``_`` so the HierarchicalMesh expanded axes (``dcn_x`` next to the
+    pod-local ``x``) bill their staged hop to DCN while the fanout axes
+    stay ICI."""
+    name = str(axis).lower()
+    if name in DCN_AXIS_TOKENS:
+        return DCN_DOMAIN
+    if any(tok in DCN_AXIS_TOKENS for tok in name.split("_")):
+        return DCN_DOMAIN
+    return ICI_DOMAIN
 
 
 def _merge(total: Dict[str, int], add: Dict[str, int], mult: int = 1):
@@ -289,3 +300,58 @@ def compare_wire(
                 )
             )
     return findings
+
+
+# The ISSUE-19 acceptance gate: the hierarchical engine's staged DCN
+# hop must carry at most this fraction of the bytes the flat sparse
+# engine pushes across the pod boundary on the same two-pod mesh.
+DCN_RATIO_MAX = 0.15
+DCN_RATIO_HIER_PROGRAM = "canonical_hierarchical_sharded"
+DCN_RATIO_FLAT_PROGRAM = "canonical_sparse_pods"
+
+
+def check_dcn_ratio(
+    wires: Dict[str, dict],
+    max_ratio: float = DCN_RATIO_MAX,
+    hier_program: str = DCN_RATIO_HIER_PROGRAM,
+    flat_program: str = DCN_RATIO_FLAT_PROGRAM,
+) -> List[ShardFinding]:
+    """Gate the two-level schedule's DCN win. Compares the DCN-domain
+    bytes of the hierarchical registry program against the flat sparse
+    engine traced on the same expanded two-pod mesh (where its dense
+    fan-out crosses the ``dcn_*`` axis and so bills entirely to DCN).
+    Skips silently when either program is absent (``--programs`` subset
+    runs); fails loudly if the denominator ever reads zero, since that
+    means the comparison program no longer crosses the pod link at all
+    and the gate would be vacuous."""
+    if hier_program not in wires or flat_program not in wires:
+        return []
+    hier_dcn = int(wires[hier_program].get("per_domain", {}).get(DCN_DOMAIN, 0))
+    flat_dcn = int(wires[flat_program].get("per_domain", {}).get(DCN_DOMAIN, 0))
+    if flat_dcn <= 0:
+        return [
+            ShardFinding(
+                "S004",
+                flat_program,
+                "DCN-ratio gate denominator is zero: the flat sparse "
+                "comparison program no longer bills any bytes to the "
+                "DCN domain, so the hierarchical-vs-sparse gate is "
+                "vacuous — check the expanded-mesh axis names against "
+                "DCN_AXIS_TOKENS",
+            )
+        ]
+    ratio = hier_dcn / flat_dcn
+    if ratio > max_ratio:
+        return [
+            ShardFinding(
+                "S004",
+                hier_program,
+                f"hierarchical DCN bytes {hier_dcn} are "
+                f"{ratio * 100.0:.1f}% of the flat sparse engine's "
+                f"cross-pod bytes {flat_dcn} (gate: <= "
+                f"{max_ratio * 100.0:.0f}%) — the staged per-(pod,pod) "
+                "hop is no longer mover-count-driven; check cross_cap "
+                "sizing and the condensed block packing",
+            )
+        ]
+    return []

@@ -746,6 +746,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 partial=args.programs is not None,
             )
         )
+        # ISSUE-19 acceptance gate: hierarchical DCN bytes must stay a
+        # sliver of the flat sparse engine's cross-pod bytes (skipped
+        # automatically when --programs leaves either side untraced).
+        new.extend(rules_shard.check_dcn_ratio(wires))
         new.sort(key=lambda f: (f.rule, f.program, f.message))
 
     if args.format == "json":

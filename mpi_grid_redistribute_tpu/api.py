@@ -170,17 +170,23 @@ def _unfuse_planar(fused, specs, R: int, out_cap: int, stacked: bool):
 
 @jax.jit
 def _accum_overflow_counters(cum, dropped_send, dropped_recv, needed,
-                             count):
+                             needed_cross, count):
     """Fold one call's overflow stats into the cumulative device-side
     counters (VERDICT round-3 weak item 1: per-call counters sampled every
     K-th call provably miss a one-call spike between samples; cumulative
     sums make the every-K read cover the WHOLE window). Runs async on
-    device — no host sync per call."""
+    device — no host sync per call. ``needed_cross`` is the hierarchical
+    engine's per-destination-pod peak (zero for every other engine), so
+    a deferred window can re-arm the DCN cross block just like
+    ``needed_capacity`` re-arms the intra mover block."""
     return {
         "dropped_send": cum["dropped_send"] + jnp.sum(dropped_send),
         "dropped_recv": cum["dropped_recv"] + jnp.sum(dropped_recv),
         "needed_capacity": jnp.maximum(
             cum["needed_capacity"], jnp.max(needed)
+        ),
+        "needed_cross": jnp.maximum(
+            cum["needed_cross"], jnp.max(needed_cross)
         ),
         "needed_out": jnp.maximum(
             cum["needed_out"], jnp.max(count + dropped_recv)
@@ -194,6 +200,7 @@ def _zero_overflow_counters():
         "dropped_send": z,
         "dropped_recv": z,
         "needed_capacity": z,
+        "needed_cross": z,
         "needed_out": z,
     }
 
@@ -286,6 +293,64 @@ def _build_count_driven_mesh_call(
     sharded = exchange.shard_redistribute_count_driven_sharded(
         mesh, domain, grid, cap, out_cap, mover_cap, domain.ndim,
         edges=edges, engine=eng,
+    )
+
+    def call(positions, count, *fields):
+        n_local = positions.shape[0] // R
+        fused = _fuse_planar(positions, fields, R, n_local, specs,
+                             stacked=False)
+        out, new_count, stats = sharded(fused, count)
+        pos_out, fields_out = _unfuse_planar(out, specs, R, out_cap,
+                                             stacked=False)
+        return pos_out, new_count, fields_out, stats
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_hierarchical_vranks_call(
+    domain: Domain, grid: ProcessGrid, hier, cap: int, out_cap: int,
+    mover_cap: int, cross_cap: int, specs, edges=None,
+):
+    """One jitted program: boundary fuse -> hierarchical two-level vrank
+    exchange -> boundary unfuse (single dispatch per call)."""
+    V = grid.nranks
+    engine = exchange.vrank_redistribute_hierarchical_fn(
+        domain, grid, hier, cap, out_cap, mover_cap, cross_cap,
+        domain.ndim, edges=edges,
+    )
+
+    def call(positions, count, *fields):
+        n_local = positions.shape[0] // V
+        fused = _fuse_planar(positions, fields, V, n_local, specs,
+                             stacked=True)
+        out, new_count, stats = engine(fused, count)
+        pos_out, fields_out = _unfuse_planar(out, specs, V, out_cap,
+                                             stacked=True)
+        return pos_out, new_count, fields_out, stats
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_hierarchical_mesh_call(
+    mesh, domain: Domain, grid: ProcessGrid, hier, cap: int, out_cap: int,
+    mover_cap: int, cross_cap: int, specs, edges=None,
+):
+    """One jitted program: boundary fuse -> shard_map hierarchical
+    two-level exchange on the EXPANDED mesh -> boundary unfuse.
+
+    ``mesh`` is the instance's FLAT mesh; its device assignment is
+    carried into ``hier.build_mesh`` so explicit user meshes keep their
+    placement (the interleaved expanded axes preserve row-major flat
+    index == grid rank, so the global layout is unchanged)."""
+    R = grid.nranks
+    emesh = hier.build_mesh(
+        None if mesh is None else list(np.asarray(mesh.devices).flat)
+    )
+    sharded = exchange.shard_redistribute_hierarchical_sharded(
+        emesh, domain, grid, hier, cap, out_cap, mover_cap, cross_cap,
+        domain.ndim, edges=edges,
     )
 
     def call(positions, count, *fields):
@@ -422,8 +487,8 @@ class GridRedistribute:
       check_every: cadence (in calls) of the deferred overflow check once
         ``'grow'`` has calibrated (default 16).
       engine: ``'auto'`` (default), ``'planar'``, ``'sparse'``,
-        ``'neighbor'`` or ``'rowmajor'`` — which canonical exchange
-        carries the payload on the jax backend.
+        ``'neighbor'``, ``'hierarchical'`` or ``'rowmajor'`` — which
+        canonical exchange carries the payload on the jax backend.
         ``'planar'`` runs the component-major ``[K, n]`` engines
         (payload-carrying-sort compaction; 2.2x the row-major engine at
         4.2M rows — BENCH_CONFIGS.md config 1): no narrow-minor ``[n, 3]``
@@ -441,9 +506,13 @@ class GridRedistribute:
         bit-identically when any shard's movers overflow ``mover_cap``
         (surfaced in ``stats.fallback``, billed at dense width in
         ``report()``'s wire model).
-        ``'auto'`` picks the count-driven sparse engine on multi-device
-        meshes, planar on one device (no wire to shrink), and falls back
-        to row-major when the payload is not 32-bit;
+        ``'hierarchical'`` is the two-level route (see ``dcn_shape``):
+        available only when ``dcn_shape`` declares more than one pod,
+        degrading to ``'sparse'`` (journaled) on flat topologies.
+        ``'auto'`` picks the hierarchical engine on multi-device
+        multi-pod meshes, the count-driven sparse engine on flat
+        multi-device meshes, planar on one device (no wire to shrink),
+        and falls back to row-major when the payload is not 32-bit;
         ``'rowmajor'`` forces the round-2 layout (kept for comparison and
         for non-32-bit payloads). All produce bit-identical results —
         same routing, same Alltoallv receive order, oracle-tested. Every
@@ -455,6 +524,29 @@ class GridRedistribute:
         grown to >= ``capacity`` degrades the instance to the planar
         engine (journaled — the count-driven pool would be no smaller
         than dense).
+      dcn_shape: optional per-axis DCN domain factors (ISSUE 19 /
+        ROADMAP item 2): each grid axis splits into ``dcn_shape[a]``
+        pods of ``grid.shape[a] // dcn_shape[a]`` ICI-connected ranks
+        (:class:`~.parallel.mesh.HierarchicalMesh`; factors must divide
+        the grid). With any factor > 1 the ``'hierarchical'`` engine
+        becomes available — and is what ``'auto'`` resolves to on
+        multi-device meshes: rows whose destination stays inside the
+        sender's pod ride the 3x3x3 neighbor ``ppermute`` schedule
+        unchanged, while boundary-crossing rows are condensed into one
+        per-destination-pod block, shipped over a single staged DCN
+        ``ppermute`` per (pod, pod) pair, and fanned out by a second
+        intra-pod hop — DCN carries mover-count-driven bytes instead of
+        dense fan-out. Bit-identical to the planar oracle; on a flat
+        topology (all factors 1, or no ``dcn_shape``) the route
+        degrades to the sparse engine (journaled), never errors.
+      cross_cap: per-destination-pod column count of the hierarchical
+        engine's condensed DCN block (pow2-bucketed, never shrinks).
+        ``None`` derives ``capacity // 8`` on first use; measured
+        ``needed_cross`` peaks ratchet it (journaled as
+        ``cross_cap_grow``) and — because cross clipping drops rows
+        rather than falling back to a dense DCN pool — an overflowing
+        call is re-run at the grown block under
+        ``on_overflow='grow'``.
       edges: optional :class:`~.domain.GridEdges` — NON-UNIFORM per-axis
         subdomain boundaries (the reference family's ``np.digitize`` /
         searchsorted-on-edges variant, SURVEY.md C1/C2). Ownership,
@@ -483,6 +575,8 @@ class GridRedistribute:
         check_every: int = 16,
         engine: str = "auto",
         mover_cap: Optional[int] = None,
+        dcn_shape: Optional[Sequence[int]] = None,
+        cross_cap: Optional[int] = None,
         edges=None,
     ):
         self.domain = _as_domain(domain, lo, hi, periodic)
@@ -527,6 +621,24 @@ class GridRedistribute:
             raise ValueError(f"mover_cap must be >= 1, got {mover_cap}")
         self._mover_cap = (
             None if mover_cap is None else _next_pow2(int(mover_cap))
+        )
+        # Two-level topology (ISSUE 19): dcn_shape splits each grid axis
+        # into (DCN pods x ICI pod-local) factors. The instance keeps the
+        # FLAT mesh as self._mesh (planar/degrade paths are untouched);
+        # the expanded mesh exists only inside the hierarchical call
+        # builders. dcn factors of all 1 still build the tables but
+        # resolve degrades to sparse (n_pods == 1 — journaled).
+        self._hier = (
+            None if dcn_shape is None
+            else mesh_lib.HierarchicalMesh(self.grid, dcn_shape)
+        )
+        # Per-destination-pod condensed cross block of the hierarchical
+        # engine (pow2-bucketed, never shrinks, grows from measured
+        # `needed_cross` peaks). None = derive from cap on first use.
+        if cross_cap is not None and int(cross_cap) < 1:
+            raise ValueError(f"cross_cap must be >= 1, got {cross_cap}")
+        self._cross_cap = (
+            None if cross_cap is None else _next_pow2(int(cross_cap))
         )
         # (requested engine, vranks, planar_ok, n_devices) of the last
         # resolve — engine_resolved is journaled only when this changes,
@@ -587,6 +699,12 @@ class GridRedistribute:
         return self.grid.nranks
 
     @property
+    def n_pods(self) -> int:
+        """Number of DCN domains (1 when no ``dcn_shape`` was given or
+        every factor is 1 — a flat mesh)."""
+        return 1 if self._hier is None else self._hier.n_pods
+
+    @property
     def mesh(self):
         if self._mesh is None:
             self._mesh = mesh_lib.make_mesh(self.grid)
@@ -637,7 +755,9 @@ class GridRedistribute:
         if self._mover_cap is None or needed <= self._mover_cap:
             return
         wire = self._last_wire
-        if wire is None or wire.get("engine") not in ("sparse", "neighbor"):
+        if wire is None or wire.get("engine") not in (
+            "sparse", "neighbor", "hierarchical"
+        ):
             return  # dense engines don't consume the wire block
         old = self._mover_cap
         self._mover_cap = _next_pow2(int(needed))
@@ -646,6 +766,104 @@ class GridRedistribute:
             old=old,
             new=self._mover_cap,
             peak_movers=int(needed),
+        )
+
+    def _cross_cap_for(self, cap: int) -> int:
+        """Per-destination-pod condensed block of the hierarchical
+        engine's staged DCN hop. Derived like :meth:`_mover_cap_for`
+        (cap/8, pow2-bucketed) on first use — at the ~2% migration
+        operating point cross-pod movers are a sliver of an
+        already-sparse flow — then only ever grows via
+        :meth:`_maybe_grow_cross_cap`."""
+        if self._cross_cap is None:
+            self._cross_cap = _next_pow2(max(1, cap // 8))
+        return self._cross_cap
+
+    def _maybe_grow_cross_cap(self, needed: int) -> bool:
+        """Grow the DCN cross block from measured ``needed_cross`` (the
+        per-source peak over destination pods of the UNCLIPPED cross
+        totals — exactly the smallest block that would have carried
+        every boundary-crossing row). Unlike the intra mover overflow,
+        cross clipping DROPS rows (no in-graph dense fallback crosses
+        DCN — that would defeat the staged schedule), so the caller
+        retries the same step when this returns True. Journals
+        ``cross_cap_grow``."""
+        if self._cross_cap is None or needed <= self._cross_cap:
+            return False
+        wire = self._last_wire
+        if wire is None or wire.get("engine") != "hierarchical":
+            return False
+        old = self._cross_cap
+        self._cross_cap = _next_pow2(int(needed))
+        self.telemetry.record(
+            "cross_cap_grow",
+            old=old,
+            new=self._cross_cap,
+            peak_cross=int(needed),
+        )
+        return True
+
+    def _hierarchical_fn(self, cap: int, out_cap: int, specs, rec):
+        """Build the hierarchical two-level call for these capacities,
+        or return ``None`` to degrade to planar when the grown mover
+        block already reached the dense pool size (mirroring the
+        count-driven degrade — journaled). Sets ``_last_wire`` with the
+        per-domain column split: the intra stage ships
+        ``n_active * mover_cap`` neighbor columns plus the
+        ``(P-1) * pod_size * cross_cap`` fanout pool over ICI, while
+        DCN carries only the ``(P-1) * cross_cap`` condensed
+        per-destination-pod blocks."""
+        if any(dt.itemsize != 4 for _shape, dt, _k in specs):
+            # callers hand us _planar_specs output, which already
+            # refused non-4-byte dtypes; re-check because the fused
+            # transport below bitcasts every row to int32 words
+            raise TypeError(
+                "hierarchical engine requires 32-bit positions and "
+                "fields (planar fused transport)"
+            )
+        B = self._mover_cap_for(cap)
+        if B >= cap:
+            if rec is None and self._last_wire is not None and (
+                self._last_wire.get("engine") != "planar"
+            ):
+                self.telemetry.record(
+                    "engine_resolved",
+                    requested=self.engine,
+                    resolved="planar",
+                    reason=(
+                        f"hierarchical: mover_cap {B} >= capacity "
+                        f"{cap}, count-driven pool no smaller than "
+                        f"dense"
+                    ),
+                    canonical=True,
+                )
+            return None
+        B2 = self._cross_cap_for(cap)
+        hier = self._hier
+        n_pods, pod_size = hier.n_pods, hier.pod_size
+        n_act = _neighbor_active_offsets(
+            hier.local_grid,
+            hier.local_periodic(tuple(self.domain.periodic)),
+        )
+        cols_ici = n_act * B + (n_pods - 1) * pod_size * B2
+        cols_dcn = (n_pods - 1) * B2
+        R = self.nranks
+        self._last_wire = {
+            "engine": "hierarchical",
+            "engine_cols": cols_ici + cols_dcn,
+            "engine_cols_ici": cols_ici,
+            "engine_cols_dcn": cols_dcn,
+            "dense_cols": R * cap,
+            "shards": R,
+        }
+        if self._vranks:
+            return _build_hierarchical_vranks_call(
+                self.domain, self.grid, hier, cap, out_cap, B, B2,
+                specs, edges=self.edges,
+            )
+        return _build_hierarchical_mesh_call(
+            self.mesh, self.domain, self.grid, hier, cap, out_cap, B,
+            B2, specs, edges=self.edges,
         )
 
     def _check_inputs(self, pos, fields, count):
@@ -724,10 +942,12 @@ class GridRedistribute:
                 exchange.RedistributeStats(**stats),
             )
         specs = None
-        if self.engine in ("auto", "planar", "sparse", "neighbor"):
+        if self.engine in (
+            "auto", "planar", "sparse", "neighbor", "hierarchical"
+        ):
             specs = _planar_specs(positions, fields)
             if specs is None and self.engine in (
-                "planar", "sparse", "neighbor"
+                "planar", "sparse", "neighbor", "hierarchical"
             ):
                 raise TypeError(
                     f"engine={self.engine!r} requires 32-bit positions and "
@@ -748,10 +968,22 @@ class GridRedistribute:
             rec = self.telemetry
         resolved = exchange.resolve_engine(
             self.engine, vranks=self._vranks, n_devices=n_dev,
-            planar_ok=specs is not None, canonical=True, recorder=rec,
+            planar_ok=specs is not None, canonical=True,
+            n_pods=self.n_pods, recorder=rec,
         )
         R = self.nranks
         dense_cols = R * cap
+        if resolved == "hierarchical" and specs is not None:
+            fn = self._hierarchical_fn(cap, out_cap, specs, rec)
+            if fn is None:
+                resolved = "planar"
+            else:
+                pos_out, new_count, fields_out, stats = fn(
+                    positions, count, *fields
+                )
+                return RedistributeResult(
+                    pos_out, fields_out, new_count, stats
+                )
         if resolved in ("sparse", "neighbor") and specs is not None:
             B = self._mover_cap_for(cap)
             if B >= cap:
@@ -887,10 +1119,12 @@ class GridRedistribute:
         cap, out_cap = self._capacities(n_local)
         self._last_row_bytes = report_lib.row_bytes_of(positions, *fields)
         specs = None
-        if self.engine in ("auto", "planar", "sparse", "neighbor"):
+        if self.engine in (
+            "auto", "planar", "sparse", "neighbor", "hierarchical"
+        ):
             specs = _planar_specs(positions, fields)
             if specs is None and self.engine in (
-                "planar", "sparse", "neighbor"
+                "planar", "sparse", "neighbor", "hierarchical"
             ):
                 raise TypeError(
                     f"engine={self.engine!r} requires 32-bit positions "
@@ -905,9 +1139,15 @@ class GridRedistribute:
             rec = self.telemetry
         resolved = exchange.resolve_engine(
             self.engine, vranks=self._vranks, n_devices=n_dev,
-            planar_ok=specs is not None, canonical=True, recorder=rec,
+            planar_ok=specs is not None, canonical=True,
+            n_pods=self.n_pods, recorder=rec,
         )
         dense_cols = R * cap
+        if resolved == "hierarchical" and specs is not None:
+            fn = self._hierarchical_fn(cap, out_cap, specs, rec)
+            if fn is not None:
+                return fn, cap, out_cap
+            resolved = "planar"
         if resolved in ("sparse", "neighbor") and specs is not None:
             B = self._mover_cap_for(cap)
             if B >= cap:
@@ -1073,6 +1313,11 @@ class GridRedistribute:
                     result.stats.dropped_send,
                     result.stats.dropped_recv,
                     result.stats.needed_capacity,
+                    (
+                        result.stats.needed_cross
+                        if result.stats.needed_cross is not None
+                        else jnp.zeros((), jnp.int32)
+                    ),
                     result.count,
                 )
                 self._deferred_check(n_local, cap, out_cap)
@@ -1086,6 +1331,12 @@ class GridRedistribute:
                     self._maybe_grow_mover_cap(
                         int(np.asarray(result.stats.needed_capacity).max())
                     )
+                    if result.stats.needed_cross is not None:
+                        # clean step: re-arm the DCN cross block for the
+                        # NEXT call (nothing was dropped — no retry)
+                        self._maybe_grow_cross_cap(
+                            int(np.asarray(result.stats.needed_cross).max())
+                        )
                 return result
             self._clean_checks = 0
             if self.on_overflow == "raise":
@@ -1098,16 +1349,26 @@ class GridRedistribute:
             # powers of two so recompiles track bucket crossings only
             needed = int(np.asarray(result.stats.needed_capacity).max())
             self._maybe_grow_mover_cap(needed)
+            # Hierarchical cross-clip drops are healed by growing the
+            # DCN cross block, not the dense capacity: a True here makes
+            # this attempt retry the SAME step at the grown cross_cap
+            # (the clipped rows were dropped, never mis-delivered).
+            grew_cross = False
+            if result.stats.needed_cross is not None:
+                grew_cross = self._maybe_grow_cross_cap(
+                    int(np.asarray(result.stats.needed_cross).max())
+                )
             needed_out = int(
                 (
                     np.asarray(result.count)
                     + np.asarray(result.stats.dropped_recv)
                 ).max()
             )
-            if not self._grow(
+            grew = self._grow(
                 dropped_send, dropped_recv, needed, needed_out, n_local,
                 cap, out_cap,
-            ):
+            )
+            if not (grew or grew_cross):
                 raise RuntimeError(
                     f"overflow not resolvable by growth (capacity {cap}, "
                     f"out_capacity {out_cap} already at their maxima): "
@@ -1414,8 +1675,12 @@ class GridRedistribute:
         self._resolved_through = max(self._resolved_through, call_idx)
         # re-arm the count-driven fast branch from the window's peak
         # per-destination need (covers the whole window: the cumulative
-        # counters fold every call's needed_capacity)
+        # counters fold every call's needed_capacity), and the DCN
+        # cross block from its per-destination-pod twin
         self._maybe_grow_mover_cap(needed)
+        self._maybe_grow_cross_cap(
+            int(np.asarray(counters.get("needed_cross", 0)))
+        )
         dropped_send = total_send - self._seen_send
         dropped_recv = total_recv - self._seen_recv
         if not dropped_send and not dropped_recv:
@@ -1578,6 +1843,20 @@ class GridRedistribute:
             wire_shards=wire.get("shards"),
         )
         out["engine"] = wire.get("engine", self.engine)
+        if "engine_cols_dcn" in wire:
+            # hierarchical two-level dispatch: split the scheduled wire
+            # into per-domain bytes — DCN carries only the condensed
+            # per-destination-pod blocks, ICI the neighbor stencil and
+            # the intra-pod fanout pool (same static model as
+            # wire_bytes_per_step, gated LOWER by telemetry/regress.py)
+            rb = self._last_row_bytes or 0
+            shards = wire.get("shards", 0)
+            out["dcn_bytes_per_step"] = (
+                wire["engine_cols_dcn"] * rb * shards
+            )
+            out["ici_bytes_per_step"] = (
+                wire["engine_cols_ici"] * rb * shards
+            )
         out["calls"] = self._call_index
         out["capacity"] = self.capacity
         out["out_capacity"] = self.out_capacity

@@ -99,6 +99,87 @@ def canonical_wire_capture(
     }
 
 
+def hierarchical_wire_capture(
+    grid_shape, dcn_shape=(2, 1, 1), migration: float = 0.02,
+    n_local: int = 1 << 12,
+) -> dict:
+    """ISSUE 19 twin of :func:`canonical_wire_capture`: the same drift
+    workload shape through the hierarchical two-level engine on a
+    virtual two-pod split of the grid, so the per-domain wire model
+    lands in the bench JSON — ``dcn_bytes_per_step`` (the staged
+    per-(pod,pod) condensed blocks, the bytes the slow cross-pod link
+    actually carries) next to ``ici_bytes_per_step`` (intra-pod
+    neighbor blocks + fanout pool). ``regress.py`` guards both LOWER
+    (``exchange_dcn_bytes_per_step`` / ``exchange_ici_bytes_per_step``),
+    auto-armed like ``exchange_wire_bytes_per_step`` was in PR 7 —
+    skipped against histories that predate the fields.
+
+    The mover block is sized exactly as the flat capture sizes it; the
+    cross block is sized from the measured per-destination-pod peak
+    with the same 1.5x headroom (overflow would clip, journal
+    ``needed_cross``, and regrow — an undersized block shows up IN the
+    guarded metric as a dense-width fallback never happens on the
+    cross stage)."""
+    from mpi_grid_redistribute_tpu import api
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    grid = ProcessGrid(grid_shape)
+    R = grid.nranks
+    m = max(1, int(round(migration * n_local)))
+    rng = np.random.default_rng(7)
+    pos = np.empty((R * n_local, 3), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        sl = slice(r * n_local, (r + 1) * n_local)
+        for a in range(3):
+            w = 1.0 / grid_shape[a]
+            pos[sl, a] = (cell[a] + rng.random(n_local)) * w
+        for i in range(m):
+            axis = (i % 6) // 2
+            sign = 1.0 if i % 2 == 0 else -1.0
+            j = r * n_local + i
+            pos[j, axis] = np.mod(
+                pos[j, axis] + sign / grid_shape[axis], 1.0
+            )
+    ids = np.arange(R * n_local, dtype=np.int32)
+    shape = np.asarray(grid_shape)
+    cells = np.floor(pos * shape).astype(np.int64) % shape
+    flat = (cells[:, 0] * shape[1] + cells[:, 1]) * shape[2] + cells[:, 2]
+    hm = mesh_lib.HierarchicalMesh(grid, dcn_shape)
+    peak = 0  # per-destination-RANK peak (sizes the intra mover block)
+    peak_cross = 0  # per-destination-POD peak (sizes the cross block)
+    for r in range(R):
+        c = grid.cell_of_rank(r)
+        home = (c[0] * shape[1] + c[1]) * shape[2] + c[2]
+        away = flat[r * n_local:(r + 1) * n_local]
+        away = away[away != home]
+        if away.size:
+            peak = max(peak, int(np.bincount(away).max()))
+            pods = np.asarray(
+                [hm.pod_of[int(d)] for d in away], np.int64
+            )
+            pods = pods[pods != hm.pod_of[r]]
+            if pods.size:
+                peak_cross = max(peak_cross, int(np.bincount(pods).max()))
+    rd = api.GridRedistribute(
+        grid=grid_shape, lo=(0.0,) * 3, hi=(1.0,) * 3,
+        periodic=(True,) * 3, engine="hierarchical",
+        mover_cap=max(2, int(peak * 1.5)),
+        dcn_shape=dcn_shape,
+        cross_cap=max(2, int(peak_cross * 1.5)),
+    )
+    rd.redistribute(pos, ids)
+    rep = rd.report()
+    return {
+        k: rep[k]
+        for k in (
+            "engine", "wire_bytes_per_step", "dense_wire_bytes_per_step",
+            "dcn_bytes_per_step", "ici_bytes_per_step",
+        )
+        if k in rep
+    }
+
+
 def run_rebalance(
     n_local: int = 4096,
     steps: int = 128,
@@ -313,6 +394,14 @@ def run(
         report["dense_wire_bytes_per_step"] = wire.get(
             "dense_wire_bytes_per_step"
         )
+        # ISSUE 19: hierarchical two-level twin at the same migration
+        # fraction on a virtual 2x(1,2,2)-pod split — per-domain wire
+        # bytes land under "report" where regress.py's auto-armed LOWER
+        # gates (exchange_dcn_bytes_per_step / _ici_) read them
+        hwire = hierarchical_wire_capture(grid_shape, (2, 1, 1), migration)
+        report["hier_wire_engine"] = hwire.get("engine")
+        report["dcn_bytes_per_step"] = hwire.get("dcn_bytes_per_step")
+        report["ici_bytes_per_step"] = hwire.get("ici_bytes_per_step")
     # grid observatory: journal the stats we already read, evaluate the
     # health rules, and ship the verdict alongside the metric — on the
     # default balanced workload this must stay OK; under BENCH_DRIFT_BIAS

@@ -37,7 +37,9 @@ from mpi_grid_redistribute_tpu.ops import binning, pack
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
 
-ENGINES = ("auto", "planar", "rowmajor", "sparse", "neighbor")
+ENGINES = (
+    "auto", "planar", "rowmajor", "sparse", "neighbor", "hierarchical"
+)
 
 
 def resolve_engine(
@@ -47,6 +49,7 @@ def resolve_engine(
     n_devices: int = 1,
     planar_ok: bool = True,
     canonical: bool = False,
+    n_pods: int = 1,
     recorder=None,
 ) -> str:
     """Resolve a user-facing engine name to a concrete engine — the ONE
@@ -64,6 +67,10 @@ def resolve_engine(
     the sparse/neighbor engines' in-graph overflow fallback.
     ``"sparse"``/``"neighbor"`` are honored as asked (the neighbor
     engine is the static 3x3x3-stencil ``ppermute`` schedule).
+    ``"hierarchical"`` is the two-level ICI/DCN schedule and needs a
+    multi-pod mesh (``n_pods > 1``); on a flat mesh it degrades to the
+    count-driven sparse engine (journaled) rather than erroring, and
+    ``"auto"`` on a multi-pod multi-device mesh picks it over sparse.
 
     Migrate loop (``canonical=False``) returns ``"sparse"`` or
     ``"planar"``: ``"auto"``/``"sparse"`` pick the mover-sparse fast
@@ -90,9 +97,24 @@ def resolve_engine(
             resolved, reason = "neighbor", "explicit neighbor stencil"
         elif engine == "sparse":
             resolved, reason = "sparse", "explicit count-driven sparse"
+        elif engine == "hierarchical":
+            if n_pods > 1:
+                resolved, reason = (
+                    "hierarchical", "explicit hierarchical two-level wire"
+                )
+            else:
+                resolved, reason = (
+                    "sparse",
+                    "hierarchical -> sparse: flat mesh (no dcn domains)",
+                )
         elif not planar_ok:
             resolved, reason = (
                 "rowmajor", "auto: payload not planar-eligible"
+            )
+        elif n_devices > 1 and n_pods > 1:
+            resolved, reason = (
+                "hierarchical",
+                "auto: multi-pod mesh -> hierarchical two-level wire",
             )
         elif n_devices > 1:
             resolved, reason = (
@@ -103,7 +125,7 @@ def resolve_engine(
                 "planar", "auto: single device, no wire to shrink"
             )
     else:
-        if engine in ("rowmajor", "neighbor"):
+        if engine in ("rowmajor", "neighbor", "hierarchical"):
             raise ValueError(
                 f"engine={engine!r} is a canonical-exchange engine; the "
                 "migrate loop accepts 'auto', 'sparse' or 'planar'"
@@ -152,7 +174,15 @@ class RedistributeStats(NamedTuple):
     ``pipeline`` ([R] int32, 1 where the step ran the software-pipelined
     steady-state branch — ISSUE 12) is only emitted by the pipelined
     resident engine and defaults to ``None`` the same way, so every
-    existing 5/6-leaf stats tree is untouched."""
+    existing 5/6-leaf stats tree is untouched.
+
+    ``needed_cross`` ([R] int32, per-source max over destination PODS of
+    the unclipped cross-pod mover total) is only emitted by the
+    hierarchical two-level engine — the smallest ``cross_cap`` that
+    would have carried every boundary-crossing row over the staged DCN
+    hop without clipping; the adaptive-growth loop in :mod:`..api`
+    ratchets its per-(pod,pod) block width from it. Defaults to ``None``
+    (empty pytree node) like ``fallback``/``pipeline``."""
 
     send_counts: jax.Array
     recv_counts: jax.Array
@@ -161,6 +191,7 @@ class RedistributeStats(NamedTuple):
     needed_capacity: jax.Array
     fallback: jax.Array = None
     pipeline: jax.Array = None
+    needed_cross: jax.Array = None
 
 
 def shard_redistribute_fn(
@@ -675,6 +706,106 @@ def _check_mover_cap(mover_cap, capacity):
     return B
 
 
+def _check_cross_cap(cross_cap):
+    B2 = int(cross_cap)
+    if B2 < 1:
+        raise ValueError(
+            f"cross_cap must be >= 1, got {B2} — it is the per-(pod,pod) "
+            f"condensed DCN block width of the hierarchical engine"
+        )
+    return B2
+
+
+def _dense_intra_wire(fi, plan, slot_valid, ici_axes):
+    """Dense INTRA-POD pool wire — the hierarchical engine's in-graph
+    fallback for the intra stage: a ``[K, L*C]`` per-local-dest pack and
+    ONE ``all_to_all`` over the ICI axes only (tiled all_to_all over a
+    subset of mesh axes runs independently per value of the remaining
+    — dcn — axes, so no DCN byte moves here). Lives at module level so
+    the cond branch functions stay free of lexical collectives (same
+    G001 discipline as :func:`_dense_pool_wire`)."""
+    with traced_span("rd:pack"):
+        packed = jnp.where(
+            slot_valid[None, :], pack.gather_plan_cols(fi, plan), 0
+        )
+    with traced_span("rd:exchange"):
+        return lax.all_to_all(
+            packed, ici_axes, split_axis=1, concat_axis=1, tiled=True
+        )
+
+
+def _hier_cross_stage(fi, order, bounds_r, prefix, eff, recv_counts, pme,
+                      pod_of_j, rank_table_j, dcn_axes, ici_axes, n_pods,
+                      L, B2, n):
+    """The staged cross-pod schedule of the hierarchical engine — runs
+    OUTSIDE the intra cond (cross rows always ride it; overflow past
+    ``cross_cap`` is clipped and counted, never densified, so no DCN
+    collective ever widens to a dense pool).
+
+    For each pod distance ``delta`` in ``1..n_pods-1``:
+
+    1. condense every row bound for pod ``(pme+delta) % n_pods`` into ONE
+       ``[K, B2]`` block (dest-rank-ascending segments at the statically
+       prefix-summed offsets — within a pod, rank-ascending ==
+       pod-local-ascending, which step 3 relies on);
+    2. one ``ppermute`` over the DCN axes shifts every pod's block (and
+       its per-local-dest segment lengths) ``delta`` pods forward —
+       this is the ONLY payload touching DCN;
+    3. the mirror rank fans the arrived block out to final destinations
+       by segmenting it with an exclusive cumsum of the arrived lengths
+       and one tiled ``all_to_all`` over the ICI axes.
+
+    Returns per-delta ``(pools [K, L*B2], source-rank keys [L*B2],
+    valid [L*B2])`` lists for the shared compaction."""
+    j_idx = jnp.arange(B2, dtype=jnp.int32)
+    m_idx = jnp.repeat(jnp.arange(L, dtype=jnp.int32), B2)
+    jj = jnp.tile(j_idx, L)
+    pools, keys, valids = [], [], []
+    with traced_span("rd:exchange"):
+        for delta in range(1, n_pods):
+            q_dst = (pme + delta) % n_pods
+            to_q = pod_of_j == q_dst                   # [R] bool (cross)
+            hit = (
+                to_q[None, :]
+                & (j_idx[:, None] >= prefix[None, :])
+                & (j_idx[:, None] < (prefix + eff)[None, :])
+            )                                          # [B2, R]
+            src_col = jnp.sum(
+                jnp.where(
+                    hit,
+                    bounds_r[None, :] + j_idx[:, None] - prefix[None, :],
+                    0,
+                ),
+                axis=1,
+            )
+            slot_valid = jnp.any(hit, axis=1)
+            plan = order[jnp.minimum(src_col, n - 1)]
+            blk = jnp.where(
+                slot_valid[None, :], pack.gather_plan_cols(fi, plan), 0
+            )                                          # [K, B2]
+            # my block's per-local-dest segment lengths in the target pod
+            eff_loc = eff[rank_table_j[q_dst]]         # [L]
+            perm_d = [(p, (p + delta) % n_pods) for p in range(n_pods)]
+            mirror = lax.ppermute(blk, dcn_axes, perm=perm_d)
+            cnt_loc = lax.ppermute(eff_loc, dcn_axes, perm=perm_d)
+            start_loc = jnp.concatenate(
+                [jnp.zeros((1,), cnt_loc.dtype), jnp.cumsum(cnt_loc)[:-1]]
+            )
+            fan_valid = jj < cnt_loc[m_idx]
+            fan_col = jnp.minimum(start_loc[m_idx] + jj, B2 - 1)
+            fan = jnp.where(fan_valid[None, :], mirror[:, fan_col], 0)
+            pool = lax.all_to_all(
+                fan, ici_axes, split_axis=1, concat_axis=1, tiled=True
+            )                                          # [K, L*B2]
+            # chunk s slot j arrived from (pod pme-delta, local s)
+            src_ranks = rank_table_j[(pme - delta) % n_pods][m_idx]
+            valid_r = jj < recv_counts[src_ranks]
+            pools.append(pool)
+            keys.append(src_ranks.astype(jnp.int32))
+            valids.append(valid_r)
+    return pools, keys, valids
+
+
 def shard_redistribute_sparse_fn(
     domain: Domain,
     grid: ProcessGrid,
@@ -683,6 +814,7 @@ def shard_redistribute_sparse_fn(
     mover_cap: int,
     ndim: int = None,
     edges=None,
+    axes=None,
 ):
     """COUNT-DRIVEN multi-device canonical exchange (under ``shard_map``).
 
@@ -705,12 +837,19 @@ def shard_redistribute_sparse_fn(
     NOTE the compaction itself still touches every resident column (the
     canonical output contract forces a full re-pack); it is the WIRE —
     the pool riding ICI — that shrinks from residents to movers.
+
+    ``axes`` overrides the mesh axes the collectives run over (default:
+    the grid's own axis names). A :class:`..mesh.HierarchicalMesh`'s
+    expanded interleaved axes keep row-major flat index == grid rank, so
+    running this engine over them is bit-identical to the flat mesh —
+    used by the shardcheck S004 comparison program to bill the flat
+    sparse wire's cross-pod bytes to the DCN domain.
     """
     R = grid.nranks
     C = capacity
     B = _check_mover_cap(mover_cap, capacity)
     D = domain.ndim if ndim is None else ndim
-    axes = grid.axis_names
+    axes = grid.axis_names if axes is None else tuple(axes)
 
     def fn(fused, count):
         as_f32, fi, n, me, is_self, order, remote_counts, bounds = (
@@ -775,6 +914,7 @@ def shard_redistribute_neighbor_fn(
     mover_cap: int,
     ndim: int = None,
     edges=None,
+    axes=None,
 ):
     """NEIGHBOR-STENCIL multi-device canonical exchange (``shard_map``).
 
@@ -797,7 +937,7 @@ def shard_redistribute_neighbor_fn(
     C = capacity
     B = _check_mover_cap(mover_cap, capacity)
     D = domain.ndim if ndim is None else ndim
-    axes = grid.axis_names
+    axes = grid.axis_names if axes is None else tuple(axes)
     periodic = tuple(bool(p) for p in domain.periodic)
     _, dst_t, src_t, member = mesh_lib.neighbor_tables(grid, periodic)
     perms_all = mesh_lib.neighbor_perms(grid, periodic)
@@ -1193,6 +1333,526 @@ def vrank_redistribute_neighbor_fn(
     return fn
 
 
+def shard_redistribute_hierarchical_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    hier,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    cross_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """HIERARCHICAL two-level canonical exchange (``shard_map`` over the
+    expanded ICI/DCN mesh of a :class:`..mesh.HierarchicalMesh`).
+
+    Two independent wire stages replace the flat schedule (ROADMAP item
+    2 — "ICI inside, DCN across"):
+
+    * **intra-pod**: rows whose destination stays inside the sender's
+      ICI domain ride the existing Moore-stencil ``ppermute`` schedule
+      unchanged, over the POD-LOCAL :func:`..mesh.neighbor_tables` and
+      the ICI axes only (a ``ppermute`` over a subset of mesh axes runs
+      independently per pod). Out-of-stencil or over-``mover_cap``
+      same-pod movers flip the (globally ``pmin``-agreed) intra stage
+      onto a bit-identical dense INTRA-POD pool — still ICI-only, so
+      the fallback never widens a DCN collective;
+    * **cross-pod** (:func:`_hier_cross_stage`): boundary-crossing rows
+      are condensed into ONE ``[K, cross_cap]`` block per destination
+      pod, shifted by a single staged DCN ``ppermute`` per (pod, pod)
+      distance, then fanned out to final ranks by a second intra-pod
+      hop — DCN carries mover-count-driven bytes instead of dense
+      fan-out. Overflow past ``cross_cap`` is clipped and counted
+      (``dropped_send`` + ``stats.needed_cross``), and the adaptive
+      loop in :mod:`..api` regrows ``cross_cap``, exactly like the
+      ``capacity`` ratchet — there is deliberately NO dense cross-pod
+      fallback in-graph.
+
+    Both stages feed the same payload-sort compaction
+    (:func:`..ops.pack.planar_compact_keys`) with per-source keys in
+    within-source pack order, so the output is byte-identical to
+    :func:`shard_redistribute_planar_fn` on every non-overflowing step.
+
+    The expanded mesh interleaves ``dcn_<name>`` axes so row-major flat
+    index == grid rank (see :class:`..mesh.HierarchicalMesh`); the
+    counts ``all_to_all`` over ALL expanded axes is therefore
+    bit-identical to the flat engines' and stats keep rank order.
+    """
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    R = grid.nranks
+    C = capacity
+    B = _check_mover_cap(mover_cap, capacity)
+    B2 = _check_cross_cap(cross_cap)
+    D = domain.ndim if ndim is None else ndim
+    if hier.grid != grid:
+        raise ValueError(
+            f"hierarchical mesh wraps grid {hier.grid.shape}, engine "
+            f"built for {grid.shape}"
+        )
+    n_pods = hier.n_pods
+    if n_pods < 2:
+        raise ValueError(
+            "hierarchical engine needs a multi-pod mesh (n_pods >= 2); "
+            "resolve_engine degrades flat meshes to the sparse engine"
+        )
+    L = hier.pod_size
+    axes_all = hier.axis_names
+    ici_axes = hier.ici_axes
+    dcn_axes = hier.dcn_axes
+    periodic_local = hier.local_periodic(domain.periodic)
+    _, dstL_t, srcL_t, memberL = mesh_lib.neighbor_tables(
+        hier.local_grid, periodic_local
+    )
+    permsL_all = mesh_lib.neighbor_perms(hier.local_grid, periodic_local)
+    activeL = tuple(o for o in range(dstL_t.shape[1]) if permsL_all[o])
+    n_actL = len(activeL)
+    permsL = tuple(permsL_all[o] for o in activeL)
+    dstL_j = jnp.asarray(dstL_t[:, activeL].reshape(L, n_actL))
+    srcL_j = jnp.asarray(srcL_t[:, activeL].reshape(L, n_actL))
+    memberL_j = jnp.asarray(memberL)                 # [L, L] bool
+    pod_of_j = jnp.asarray(hier.pod_of)              # [R]
+    local_of_j = jnp.asarray(hier.local_of)          # [R]
+    rank_table_j = jnp.asarray(hier.rank_table)      # [n_pods, L]
+    same_np = hier.pod_of[:, None] == hier.pod_of[None, :]
+    # prefix matrix: M[d', d] = 1 iff d' < d and same destination pod —
+    # the condensed block's segment offsets in one matvec
+    M_j = jnp.asarray(
+        (
+            (np.arange(R)[:, None] < np.arange(R)[None, :]) & same_np
+        ).astype(np.int32)
+    )
+    pod_onehot_j = jnp.asarray(
+        (hier.pod_of[None, :] == np.arange(n_pods)[:, None]).astype(
+            np.int32
+        )
+    )                                                # [n_pods, R]
+
+    def fn(fused, count):
+        as_f32, fi, n, me, is_self, order, remote_counts, bounds = (
+            _planar_shard_prefix(
+                fused, count, domain, grid, D, edges, axes_all
+            )
+        )
+        K = fi.shape[0]
+        pme = lax.axis_index(dcn_axes).astype(jnp.int32)   # pod id
+        lme = lax.axis_index(ici_axes).astype(jnp.int32)   # pod-local
+        same_pod = pod_of_j == pme
+        cross_mask = ~same_pod
+        sc = jnp.minimum(remote_counts, C)
+        sc_cross = jnp.where(cross_mask, sc, 0)
+        prefix = sc_cross @ M_j                      # [R] block offsets
+        eff = jnp.where(
+            cross_mask, jnp.clip(B2 - prefix, 0, sc), sc
+        ).astype(jnp.int32)
+        dropped_send = jnp.sum(remote_counts - eff)
+        send_counts = eff
+        with traced_span("rd:exchange"):
+            recv_counts = lax.all_to_all(
+                send_counts, axes_all, split_axis=0, concat_axis=0,
+                tiled=True,
+            )
+        needed_cross = jnp.max(pod_onehot_j @ sc_cross).astype(jnp.int32)
+
+        cross_pools, cross_keys, cross_valid = _hier_cross_stage(
+            fi, order, bounds[:R], prefix, eff, recv_counts, pme,
+            pod_of_j, rank_table_j, dcn_axes, ici_axes, n_pods, L, B2, n,
+        )
+
+        # intra guard: same-pod movers must fit the pod-local stencil
+        # blocks; cross rows never enter this cond (clip-and-count).
+        member_row = memberL_j[lme][local_of_j]      # [R] bool
+        ok = jnp.all(
+            jnp.where(
+                same_pod,
+                jnp.where(
+                    member_row, remote_counts <= B, remote_counts == 0
+                ),
+                True,
+            )
+        ).astype(jnp.int32)
+        guard = lax.pmin(ok, axes_all)
+
+        def _finish(pool, valid_r, srckeys):
+            invalid = ~jnp.concatenate([valid_r] + cross_valid + [is_self])
+            source_key = jnp.concatenate(
+                [srckeys] + cross_keys + [jnp.broadcast_to(me, (n,))]
+            ).astype(jnp.int32)
+            values = jnp.concatenate([pool] + cross_pools + [fi], axis=1)
+            new_full = (
+                jnp.sum(recv_counts) + jnp.sum(is_self.astype(jnp.int32))
+            )
+            with traced_span("rd:unpack"):
+                return pack.planar_compact_keys(
+                    values, invalid, source_key, R, new_full, out_capacity
+                )
+
+        def _stencil(_):
+            if n_actL == 0:
+                # one-rank pods: no intra links, nothing same-pod to wire
+                pool = jnp.zeros((K, 0), jnp.int32)
+                valid_r = jnp.zeros((0,), bool)
+                srckeys = jnp.zeros((0,), jnp.int32)
+                return _finish(pool, valid_r, srckeys)
+            d_o = jnp.take(dstL_j, lme, axis=0)      # [n_actL] local ids
+            d_safe = jnp.where(d_o >= 0, d_o, 0)
+            d_glob = rank_table_j[pme, d_safe]       # [n_actL]
+            sc_b = jnp.minimum(sc, B)
+            cnt = jnp.where(d_o >= 0, sc_b[d_glob], 0)
+            c_idx = jnp.arange(B, dtype=jnp.int32)
+            flat_c = jnp.tile(c_idx, n_actL)
+            off_i = jnp.repeat(jnp.arange(n_actL, dtype=jnp.int32), B)
+            slot_valid = flat_c < cnt[off_i]
+            src_cols = jnp.minimum(bounds[d_glob][off_i] + flat_c, n - 1)
+            plan = order[src_cols]
+            pool = _neighbor_wire(
+                fi, plan, slot_valid, ici_axes, permsL, n_actL, B
+            )
+            s_o = jnp.take(srcL_j, lme, axis=0)      # [n_actL]
+            s_safe = jnp.where(s_o >= 0, s_o, 0)
+            s_glob = rank_table_j[pme, s_safe]
+            rc = jnp.where(s_o >= 0, recv_counts[s_glob], 0)
+            valid_r = flat_c < rc[off_i]
+            return _finish(pool, valid_r, s_glob[off_i])
+
+        def _dense_intra(_):
+            m_all = jnp.repeat(jnp.arange(L, dtype=jnp.int32), C)
+            cc = jnp.tile(jnp.arange(C, dtype=jnp.int32), L)
+            d_glob_all = rank_table_j[pme, m_all]    # [L*C]
+            cnt_all = jnp.where(same_pod, sc, 0)[d_glob_all]
+            slot_valid = cc < cnt_all
+            src_cols = jnp.minimum(bounds[d_glob_all] + cc, n - 1)
+            plan = order[src_cols]
+            pool = _dense_intra_wire(fi, plan, slot_valid, ici_axes)
+            valid_r = cc < recv_counts[d_glob_all]
+            return _finish(pool, valid_r, d_glob_all)
+
+        out, new_count, dropped_recv = lax.cond(
+            guard == 1, _stencil, _dense_intra, operand=None
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32))
+        self_onehot = (jnp.arange(R, dtype=jnp.int32) == me) * self_count
+        stats = RedistributeStats(
+            send_counts=(send_counts + self_onehot)[None, :],
+            recv_counts=(recv_counts + self_onehot)[None, :],
+            dropped_send=dropped_send[None].astype(jnp.int32),
+            dropped_recv=dropped_recv[None],
+            needed_capacity=jnp.max(remote_counts)[None].astype(jnp.int32),
+            fallback=(1 - guard)[None].astype(jnp.int32),
+            needed_cross=needed_cross[None],
+        )
+        return out, new_count[None], stats
+
+    return fn
+
+
+def vrank_redistribute_hierarchical_fn(
+    domain: Domain,
+    grid: ProcessGrid,
+    hier,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    cross_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """HIERARCHICAL two-level canonical exchange, vrank twin: the staged
+    DCN ``ppermute`` + intra-pod fanout become static cross-vrank block
+    gathers through the SAME :class:`..mesh.HierarchicalMesh` tables the
+    sharded engine ships (pod ids, pod-local ranks, per-(pod,pod)
+    routes), so one chip exercises the exact two-level schedule — guard,
+    clip-and-count cross overflow, block order — the fleet runs.
+    Bit-identical to the planar vrank engine on non-overflowing steps.
+    """
+    from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+    V = grid.nranks
+    C = capacity
+    B = _check_mover_cap(mover_cap, capacity)
+    B2 = _check_cross_cap(cross_cap)
+    D = domain.ndim if ndim is None else ndim
+    if hier.grid != grid:
+        raise ValueError(
+            f"hierarchical mesh wraps grid {hier.grid.shape}, engine "
+            f"built for {grid.shape}"
+        )
+    n_pods = hier.n_pods
+    if n_pods < 2:
+        raise ValueError(
+            "hierarchical engine needs a multi-pod mesh (n_pods >= 2); "
+            "resolve_engine degrades flat meshes to the sparse engine"
+        )
+    L = hier.pod_size
+    periodic_local = hier.local_periodic(domain.periodic)
+    _, dstL_t, srcL_t, memberL = mesh_lib.neighbor_tables(
+        hier.local_grid, periodic_local
+    )
+    permsL_all = mesh_lib.neighbor_perms(hier.local_grid, periodic_local)
+    activeL = tuple(o for o in range(dstL_t.shape[1]) if permsL_all[o])
+    n_actL = len(activeL)
+    pod_of = hier.pod_of
+    local_of = hier.local_of
+    rank_table = hier.rank_table
+    # pod-local stencil tables lifted to GLOBAL ranks per vrank
+    dstL_act = dstL_t[:, activeL].reshape(L, n_actL)
+    srcL_act = srcL_t[:, activeL].reshape(L, n_actL)
+    dst_loc = dstL_act[local_of]                     # [V, n_actL]
+    src_loc = srcL_act[local_of]
+    dst_glob = np.where(
+        dst_loc >= 0,
+        rank_table[pod_of[:, None], np.where(dst_loc >= 0, dst_loc, 0)],
+        -1,
+    )
+    src_glob = np.where(
+        src_loc >= 0,
+        rank_table[pod_of[:, None], np.where(src_loc >= 0, src_loc, 0)],
+        -1,
+    )
+    d_valid = jnp.asarray(dst_glob >= 0)
+    d_safe = jnp.asarray(np.where(dst_glob >= 0, dst_glob, 0))
+    s_valid = jnp.asarray(src_glob >= 0)
+    s_safe = jnp.asarray(np.where(src_glob >= 0, src_glob, 0))
+    same_np = pod_of[:, None] == pod_of[None, :]
+    member_j = jnp.asarray(
+        same_np & memberL[local_of[:, None], local_of[None, :]]
+    )
+    same_j = jnp.asarray(same_np)
+    cross_j = jnp.asarray(~same_np)
+    M_j = jnp.asarray(
+        (
+            (np.arange(V)[:, None] < np.arange(V)[None, :]) & same_np
+        ).astype(np.int32)
+    )
+    pod_onehot_t = jnp.asarray(
+        (pod_of[:, None] == np.arange(n_pods)[None, :]).astype(np.int32)
+    )                                                # [V, n_pods]
+    # per-delta static cross tables
+    to_q_np = []
+    mirror_src_np = []
+    dst_loc_idx_np = []
+    keys_np = []
+    for delta in range(n_pods):
+        q_dst = (pod_of + delta) % n_pods
+        to_q_np.append(pod_of[None, :] == q_dst[:, None])
+        mirror_src_np.append(rank_table[(pod_of - delta) % n_pods, local_of])
+        dst_loc_idx_np.append(rank_table[q_dst])     # [V, L]
+        keys_np.append(
+            np.repeat(rank_table[(pod_of - delta) % n_pods], B2, axis=1)
+        )                                            # [V, L*B2]
+    # fanout "all_to_all over ici axes" as a static within-pod gather
+    row_idx_np = np.repeat(rank_table[pod_of], B2, axis=1)   # [V, L*B2]
+    col_idx_np = (
+        local_of[:, None] * B2 + np.tile(np.arange(B2), L)[None, :]
+    )
+    m_rep_np = np.repeat(np.arange(L), B2)
+    # dense-intra static tables ([V, L*C])
+    dloc_np = np.repeat(rank_table[pod_of], C, axis=1)
+    drow_np = dloc_np
+    dcol_np = local_of[:, None] * C + np.tile(np.arange(C), L)[None, :]
+
+    def fn(fused, count):
+        as_f32, fi, pos_f = _validate_planar_vranks(fused, V, D)
+        n = fused.shape[2]
+        K = fused.shape[1]
+        me_ids, is_self, order, remote_counts, bounds = (
+            _vrank_sparse_prefix(fi, pos_f, count, domain, grid, edges, n)
+        )
+        sc = jnp.minimum(remote_counts, C)           # [V, V]
+        sc_cross = jnp.where(cross_j, sc, 0)
+        prefix = sc_cross @ M_j
+        eff = jnp.where(
+            cross_j, jnp.clip(B2 - prefix, 0, sc), sc
+        ).astype(jnp.int32)
+        dropped_send = jnp.sum(remote_counts - eff, axis=1)
+        send_counts = eff
+        recv_counts = eff.T
+        needed = jnp.max(remote_counts, axis=1).astype(jnp.int32)
+        needed_cross = jnp.max(
+            sc_cross @ pod_onehot_t, axis=1
+        ).astype(jnp.int32)
+
+        j_idx = jnp.arange(B2, dtype=jnp.int32)
+        jj = jnp.tile(j_idx, L)
+        cross_pools, cross_keys, cross_valid = [], [], []
+        with traced_span("rd:exchange"):
+            for delta in range(1, n_pods):
+                to_q = jnp.asarray(to_q_np[delta])
+                hit = (
+                    to_q[:, None, :]
+                    & (j_idx[None, :, None] >= prefix[:, None, :])
+                    & (j_idx[None, :, None] < (prefix + eff)[:, None, :])
+                )                                    # [V, B2, V]
+                src_col = jnp.sum(
+                    jnp.where(
+                        hit,
+                        bounds[:, None, :V]
+                        + j_idx[None, :, None]
+                        - prefix[:, None, :],
+                        0,
+                    ),
+                    axis=2,
+                )
+                slot_valid = jnp.any(hit, axis=2)
+                plan = jnp.take_along_axis(
+                    order, jnp.minimum(src_col, n - 1), axis=1
+                )
+                blk = jax.vmap(pack.gather_plan_cols)(fi, plan)
+                blk = jnp.where(slot_valid[:, None, :], blk, 0)
+                # the DCN hop, as a static gather: vrank v's mirror
+                # block came from (pod_of[v]-delta, local_of[v])
+                mirror = blk[mirror_src_np[delta]]
+                cnt_loc = jnp.take_along_axis(
+                    eff, jnp.asarray(dst_loc_idx_np[delta]), axis=1
+                )[mirror_src_np[delta]]              # [V, L] arrived lens
+                start_loc = jnp.concatenate(
+                    [
+                        jnp.zeros((V, 1), cnt_loc.dtype),
+                        jnp.cumsum(cnt_loc, axis=1)[:, :-1],
+                    ],
+                    axis=1,
+                )
+                fan_valid = jj[None, :] < cnt_loc[:, m_rep_np]
+                fan_col = jnp.minimum(
+                    start_loc[:, m_rep_np] + jj[None, :], B2 - 1
+                )
+                fan = jax.vmap(pack.gather_plan_cols)(mirror, fan_col)
+                fan = jnp.where(fan_valid[:, None, :], fan, 0)
+                # the intra-pod fanout hop, as a static gather
+                arrived = fan[
+                    row_idx_np[:, None, :],
+                    jnp.arange(K)[None, :, None],
+                    col_idx_np[:, None, :],
+                ]                                    # [V, K, L*B2]
+                keys = jnp.asarray(keys_np[delta])
+                valid_r = jj[None, :] < jnp.take_along_axis(
+                    recv_counts, keys, axis=1
+                )
+                cross_pools.append(arrived)
+                cross_keys.append(keys)
+                cross_valid.append(valid_r)
+
+        guard = jnp.all(
+            jnp.where(
+                same_j,
+                jnp.where(member_j, remote_counts <= B, remote_counts == 0),
+                True,
+            )
+        )
+
+        def _finish(pool, valid_r, srckeys):
+            invalid = ~jnp.concatenate(
+                [valid_r] + cross_valid + [is_self], axis=1
+            )
+            source_key = jnp.concatenate(
+                [srckeys]
+                + cross_keys
+                + [jnp.broadcast_to(me_ids[:, None], (V, n))],
+                axis=1,
+            ).astype(jnp.int32)
+            values = jnp.concatenate([pool] + cross_pools + [fi], axis=2)
+            new_full = jnp.sum(recv_counts, axis=1) + jnp.sum(
+                is_self.astype(jnp.int32), axis=1
+            )
+
+            def compact_one(vals_v, inv_v, sk_v, nf_v):
+                return pack.planar_compact_keys(
+                    vals_v, inv_v, sk_v, V, nf_v, out_capacity
+                )
+
+            with traced_span("rd:unpack"):
+                return jax.vmap(compact_one)(
+                    values, invalid, source_key, new_full
+                )
+
+        def _stencil(_):
+            sc_b = jnp.minimum(sc, B)
+            cnt = jnp.where(
+                d_valid, jnp.take_along_axis(sc_b, d_safe, axis=1), 0
+            )                                        # [V, n_actL]
+            base = jnp.take_along_axis(bounds, d_safe, axis=1)
+            c_idx = jnp.arange(B, dtype=jnp.int32)
+            slot_valid = (
+                c_idx[None, None, :] < cnt[:, :, None]
+            ).reshape(V, n_actL * B)
+            src_cols = jnp.minimum(
+                base[:, :, None] + c_idx[None, None, :], n - 1
+            ).reshape(V, n_actL * B)
+            plan = jnp.take_along_axis(order, src_cols, axis=1)
+            with traced_span("rd:pack"):
+                send = jax.vmap(pack.gather_plan_cols)(fi, plan)
+                send = jnp.where(slot_valid[:, None, :], send, 0)
+            blocks = send.reshape(V, K, n_actL, B)
+            with traced_span("rd:exchange"):
+                recv = blocks[
+                    s_safe, :, jnp.arange(n_actL)[None, :], :
+                ]                                    # [V, n_actL, K, B]
+                pool = recv.transpose(0, 2, 1, 3).reshape(
+                    V, K, n_actL * B
+                )
+            rc = jnp.where(
+                s_valid,
+                jnp.take_along_axis(recv_counts, s_safe, axis=1),
+                0,
+            )
+            valid_r = (
+                c_idx[None, None, :] < rc[:, :, None]
+            ).reshape(V, n_actL * B)
+            srckeys = jnp.broadcast_to(
+                s_safe[:, :, None], (V, n_actL, B)
+            ).reshape(V, n_actL * B)
+            return _finish(pool, valid_r, srckeys)
+
+        def _dense_intra(_):
+            cc = jnp.tile(jnp.arange(C, dtype=jnp.int32), L)
+            dloc = jnp.asarray(dloc_np)
+            cnt_all = jnp.take_along_axis(
+                jnp.where(same_j, sc, 0), dloc, axis=1
+            )                                        # [V, L*C]
+            slot_valid = cc[None, :] < cnt_all
+            src_cols = jnp.minimum(
+                jnp.take_along_axis(bounds, dloc, axis=1) + cc[None, :],
+                n - 1,
+            )
+            plan = jnp.take_along_axis(order, src_cols, axis=1)
+            with traced_span("rd:pack"):
+                packed = jax.vmap(pack.gather_plan_cols)(fi, plan)
+                packed = jnp.where(slot_valid[:, None, :], packed, 0)
+            with traced_span("rd:exchange"):
+                pool = packed[
+                    drow_np[:, None, :],
+                    jnp.arange(K)[None, :, None],
+                    dcol_np[:, None, :],
+                ]                                    # [V, K, L*C]
+            valid_r = cc[None, :] < jnp.take_along_axis(
+                recv_counts, dloc, axis=1
+            )
+            return _finish(pool, valid_r, dloc)
+
+        out, new_count, dropped_recv = lax.cond(
+            guard, _stencil, _dense_intra, operand=None
+        )
+        if as_f32:
+            out = lax.bitcast_convert_type(out, jnp.float32)
+        self_count = jnp.sum(is_self.astype(jnp.int32), axis=1)
+        self_diag = jnp.diag(self_count)
+        stats = RedistributeStats(
+            send_counts=send_counts + self_diag,
+            recv_counts=recv_counts + self_diag,
+            dropped_send=dropped_send.astype(jnp.int32),
+            dropped_recv=dropped_recv,
+            needed_capacity=needed,
+            fallback=jnp.broadcast_to((~guard).astype(jnp.int32), (V,)),
+            needed_cross=needed_cross,
+        )
+        return out, new_count, stats
+
+    return fn
+
+
 _COUNT_DRIVEN_SHARD_FNS = {
     "sparse": shard_redistribute_sparse_fn,
     "neighbor": shard_redistribute_neighbor_fn,
@@ -1220,16 +1880,20 @@ def shard_redistribute_count_driven_sharded(
     ndim: int = None,
     edges=None,
     engine: str = "sparse",
+    axes=None,
 ):
     """``shard_map``-wrapped count-driven exchange (``engine`` picks the
     sparse all_to_all or neighbor ppermute wire). Same global layout as
     :func:`shard_redistribute_planar_sharded`; the stats tree carries the
-    extra ``fallback`` leaf ([R] int32)."""
-    axes = grid.axis_names
+    extra ``fallback`` leaf ([R] int32). ``axes`` overrides the mesh
+    axes (expanded hierarchical meshes — see
+    :func:`shard_redistribute_sparse_fn`)."""
+    axes = grid.axis_names if axes is None else tuple(axes)
     spec_f = P(None, axes)
     spec_c = P(axes)
     fn = _COUNT_DRIVEN_SHARD_FNS[engine](
-        domain, grid, capacity, out_capacity, mover_cap, ndim, edges=edges
+        domain, grid, capacity, out_capacity, mover_cap, ndim, edges=edges,
+        axes=axes,
     )
     out_specs = (
         spec_f,
@@ -1254,12 +1918,93 @@ def build_redistribute_count_driven(
     ndim: int = None,
     edges=None,
     engine: str = "sparse",
+    axes=None,
 ):
     """jit of :func:`shard_redistribute_count_driven_sharded`."""
     return jax.jit(
         shard_redistribute_count_driven_sharded(
             mesh, domain, grid, capacity, out_capacity, mover_cap, ndim,
-            edges=edges, engine=engine,
+            edges=edges, engine=engine, axes=axes,
+        )
+    )
+
+
+def shard_redistribute_hierarchical_sharded(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    hier,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    cross_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """``shard_map``-wrapped hierarchical two-level exchange. ``mesh``
+    must be the EXPANDED mesh (``hier.build_mesh()``); the global layout
+    is identical to :func:`shard_redistribute_planar_sharded` because
+    the interleaved expanded axes keep row-major flat index == grid
+    rank. The stats tree carries ``fallback`` (intra stage) AND
+    ``needed_cross`` ([R] int32)."""
+    axes = hier.axis_names
+    spec_f = P(None, axes)
+    spec_c = P(axes)
+    fn = shard_redistribute_hierarchical_fn(
+        domain, grid, hier, capacity, out_capacity, mover_cap, cross_cap,
+        ndim, edges=edges,
+    )
+    out_specs = (
+        spec_f,
+        spec_c,
+        RedistributeStats(
+            spec_c, spec_c, spec_c, spec_c, spec_c, spec_c, None, spec_c
+        ),
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec_f, spec_c), out_specs=out_specs
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute_hierarchical(
+    mesh: Mesh,
+    domain: Domain,
+    grid: ProcessGrid,
+    hier,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    cross_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """jit of :func:`shard_redistribute_hierarchical_sharded`."""
+    return jax.jit(
+        shard_redistribute_hierarchical_sharded(
+            mesh, domain, grid, hier, capacity, out_capacity, mover_cap,
+            cross_cap, ndim, edges=edges,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_redistribute_hierarchical_vranks(
+    domain: Domain,
+    grid: ProcessGrid,
+    hier,
+    capacity: int,
+    out_capacity: int,
+    mover_cap: int,
+    cross_cap: int,
+    ndim: int = None,
+    edges=None,
+):
+    """jit of :func:`vrank_redistribute_hierarchical_fn`."""
+    return jax.jit(
+        vrank_redistribute_hierarchical_fn(
+            domain, grid, hier, capacity, out_capacity, mover_cap,
+            cross_cap, ndim, edges=edges,
         )
     )
 
@@ -1397,6 +2142,7 @@ def resolve_two_phase(
     ragged: bool = False,
     vranks: bool = False,
     n_devices: int = 1,
+    n_pods: int = 1,
     build=None,
     recorder=None,
 ) -> TwoPhaseExchange:
@@ -1422,8 +2168,10 @@ def resolve_two_phase(
     (deferred so degraded resolutions never trace it); ``recorder``
     journals the decision as ``engine_resolved`` with
     ``requested=engine``, ``resolved`` in {"pipeline", "sequential"}
-    and one of the five "pipeline: ..." reason strings
-    (telemetry/SCHEMA.md).
+    and one of the six "pipeline: ..." reason strings
+    (telemetry/SCHEMA.md) — a multi-pod hierarchical topology
+    (``n_pods > 1``) degrades like the multi-device case: the two-level
+    wire has no two-phase surface yet.
     """
     if engine not in ENGINES:
         raise ValueError(
@@ -1442,6 +2190,11 @@ def resolve_two_phase(
     elif not (vranks or n_devices == 1):
         armed, reason = (
             False, "pipeline: multi-device topology — sequential body"
+        )
+    elif n_pods > 1:
+        armed, reason = (
+            False,
+            "pipeline: hierarchical multi-pod topology — sequential body",
         )
     else:
         armed, reason = True, "pipeline: armed (vranks planar two-phase)"

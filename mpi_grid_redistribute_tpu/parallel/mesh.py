@@ -111,8 +111,33 @@ def initialize_distributed(**kwargs) -> None:
     jax.distributed.initialize(**kwargs)
 
 
+def _validate_dcn_shape(
+    grid: ProcessGrid, dcn_shape: Optional[Sequence[int]]
+) -> Tuple[int, ...]:
+    """Shared dcn-shape validation of :func:`make_hybrid_mesh` and
+    :class:`HierarchicalMesh`: per-axis pod counts must match the grid's
+    ndim and divide each grid extent. ``None`` means all-ones (flat)."""
+    if dcn_shape is None:
+        dcn_shape = (1,) * grid.ndim
+    dcn_shape = tuple(int(d) for d in dcn_shape)
+    if len(dcn_shape) != grid.ndim:
+        raise ValueError(
+            f"dcn_shape must have {grid.ndim} axes, got {dcn_shape}"
+        )
+    for a, (g, d) in enumerate(zip(grid.shape, dcn_shape)):
+        if d < 1:
+            raise ValueError(
+                f"axis {a}: dcn factor must be >= 1, got {d}"
+            )
+        if g % d:
+            raise ValueError(
+                f"axis {a}: grid extent {g} not divisible by dcn {d}"
+            )
+    return dcn_shape
+
+
 def make_hybrid_mesh(
-    grid: ProcessGrid, dcn_shape: Sequence[int] = None
+    grid: ProcessGrid, dcn_shape: Optional[Sequence[int]] = None
 ) -> Mesh:
     """Mesh for multi-slice / multi-host jobs: ICI inside a slice, DCN
     across slices.
@@ -126,24 +151,136 @@ def make_hybrid_mesh(
     """
     from jax.experimental import mesh_utils
 
-    if dcn_shape is None:
-        dcn_shape = (1,) * grid.ndim
-    dcn_shape = tuple(int(d) for d in dcn_shape)
-    if len(dcn_shape) != grid.ndim:
-        raise ValueError(
-            f"dcn_shape must have {grid.ndim} axes, got {dcn_shape}"
-        )
-    for a, (g, d) in enumerate(zip(grid.shape, dcn_shape)):
-        if g % d:
-            raise ValueError(
-                f"axis {a}: grid extent {g} not divisible by dcn {d}"
-            )
+    dcn_shape = _validate_dcn_shape(grid, dcn_shape)
     if all(d == 1 for d in dcn_shape):
         devices = mesh_utils.create_device_mesh(grid.shape)
     else:
         ici = tuple(g // d for g, d in zip(grid.shape, dcn_shape))
         devices = mesh_utils.create_hybrid_device_mesh(ici, dcn_shape)
     return Mesh(devices, grid.axis_names)
+
+
+class HierarchicalMesh:
+    """Two-level (ICI-inside, DCN-across) view of a process grid.
+
+    ``dcn_shape[a]`` splits grid axis ``a`` into ``d_a`` pods of
+    ``g_a // d_a`` ranks each. The *expanded* mesh interleaves a
+    ``dcn_<name>`` axis (extent ``d_a``) in front of each split grid
+    axis (extent ``g_a // d_a``), so the row-major flat index over the
+    expanded axes **equals the grid rank**:
+
+    ``cell_a = pod_a * ici_a + local_a`` and row-major interleaving
+    compose exactly — ``lax.axis_index(axis_names)`` inside a
+    ``shard_map`` over :meth:`build_mesh` is the grid rank, any
+    collective over ALL expanded axes is bit-identical to the same
+    collective on the flat mesh, ``lax.axis_index(dcn_axes)`` is the
+    pod id and ``lax.axis_index(ici_axes)`` the pod-local rank.
+
+    Static routing tables (numpy, trace-time):
+
+    * ``pod_of [R]`` / ``local_of [R]`` — pod id and pod-local flat
+      index of each grid rank;
+    * ``rank_table [n_pods, pod_size]`` — grid rank of pod-local slot
+      ``l`` in pod ``p`` (ascending in ``l`` for fixed ``p``, which is
+      what lets the DCN mirror reconstruct block segmentation from
+      per-local-destination counts alone);
+    * ``local_grid`` — a :class:`ProcessGrid` over the pod's ICI shape,
+      feeding :func:`neighbor_tables` for the intra-pod stencil.
+    """
+
+    def __init__(
+        self, grid: ProcessGrid, dcn_shape: Optional[Sequence[int]] = None
+    ):
+        self.grid = grid
+        self.dcn_shape = _validate_dcn_shape(grid, dcn_shape)
+        self.ici_shape = tuple(
+            g // d for g, d in zip(grid.shape, self.dcn_shape)
+        )
+        self.n_pods = math.prod(self.dcn_shape)
+        self.pod_size = math.prod(self.ici_shape)
+        names = []
+        sizes = []
+        dcn_axes = []
+        for name, g, d in zip(grid.axis_names, grid.shape, self.dcn_shape):
+            if d > 1:
+                names.append("dcn_" + name)
+                sizes.append(d)
+                dcn_axes.append("dcn_" + name)
+            names.append(name)
+            sizes.append(g // d)
+        self.axis_names = tuple(names)
+        self.axis_sizes = tuple(sizes)
+        self.dcn_axes = tuple(dcn_axes)
+        self.ici_axes = tuple(grid.axis_names)
+        self.local_grid = ProcessGrid(self.ici_shape)
+        R = grid.nranks
+        pod_of = np.zeros(R, dtype=np.int32)
+        local_of = np.zeros(R, dtype=np.int32)
+        rank_table = np.zeros((self.n_pods, self.pod_size), dtype=np.int32)
+        for r in range(R):
+            cell = grid.cell_of_rank(r)
+            p = 0
+            l = 0
+            for a in range(grid.ndim):
+                p = p * self.dcn_shape[a] + cell[a] // self.ici_shape[a]
+                l = l * self.ici_shape[a] + cell[a] % self.ici_shape[a]
+            pod_of[r] = p
+            local_of[r] = l
+            rank_table[p, l] = r
+        self.pod_of = pod_of
+        self.local_of = local_of
+        self.rank_table = rank_table
+
+    def local_periodic(self, periodic: Sequence[bool]) -> Tuple[bool, ...]:
+        """Periodicity of the pod-local grid: a wrapped axis stays
+        periodic inside the pod only when the pod spans the whole axis
+        (``d_a == 1``); split axes wrap across pods, which the cross
+        stage handles, so the local stencil must not."""
+        return tuple(
+            bool(p) and d == 1 for p, d in zip(periodic, self.dcn_shape)
+        )
+
+    def build_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Expanded-axes ``Mesh``. Device r of the flat layout lands at
+        expanded coordinates whose row-major flat index is r, so the
+        hybrid ICI/DCN placement of :func:`make_hybrid_mesh` carries
+        over by pure reshape (dcn digits are the slow factors on both
+        sides). On backends without slice topology (CPU) falls back to
+        the plain rank-ordered layout."""
+        if devices is None:
+            if any(d > 1 for d in self.dcn_shape):
+                try:
+                    arr = make_hybrid_mesh(self.grid, self.dcn_shape).devices
+                except ValueError:
+                    arr = make_mesh(self.grid).devices
+            else:
+                arr = make_mesh(self.grid).devices
+        else:
+            if len(devices) < self.grid.nranks:
+                raise ValueError(
+                    f"grid {self.grid.shape} needs {self.grid.nranks} "
+                    f"devices, only {len(devices)} available"
+                )
+            arr = np.asarray(
+                devices[: self.grid.nranks], dtype=object
+            ).reshape(self.grid.shape)
+        return Mesh(arr.reshape(self.axis_sizes), self.axis_names)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, HierarchicalMesh)
+            and self.grid == other.grid
+            and self.dcn_shape == other.dcn_shape
+        )
+
+    def __hash__(self) -> int:
+        return hash((HierarchicalMesh, self.grid, self.dcn_shape))
+
+    def __repr__(self) -> str:
+        return (
+            f"HierarchicalMesh(grid={self.grid.shape}, "
+            f"dcn={self.dcn_shape})"
+        )
 
 
 def stencil_offsets(ndim: int) -> Tuple[Tuple[int, ...], ...]:

@@ -93,7 +93,8 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
 
     When :func:`..parallel.exchange.resolve_two_phase` degrades the
     schedule (chunk < 2, non-planar payload, ragged receive capacity,
-    multi-device topology) this DELEGATES to the sequential builder —
+    multi-device or multi-pod topology) this DELEGATES to the
+    sequential builder —
     the returned macro is bit-exactly the sequential one, including its
     ``ResidentLayoutError`` on ragged carries — and the degradation is
     journaled. Because this builder runs under the driver's causal step
@@ -144,6 +145,7 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
         ragged=out_cap != n_local,
         vranks=rd._vranks,
         n_devices=n_dev,
+        n_pods=rd.n_pods,
         build=lambda: migrate.vrank_exchange_two_phase_fn(
             rd.domain, rd.grid, n_local, ndim=rd.domain.ndim
         ),

@@ -82,6 +82,16 @@ GUARDED_METRICS: Dict[str, str] = {
     # schedule while pps holds. Auto-arms: skipped against histories
     # that predate the field (the PR 3 pattern).
     "exchange_wire_bytes_per_step": "lower",
+    # per-domain split of the hierarchical two-level schedule's wire
+    # (ISSUE 19, bench/config4_drift.hierarchical_wire_capture on the
+    # virtual two-pod mesh): the DCN column is the bytes the slow
+    # cross-pod link carries (staged per-(pod,pod) condensed blocks) —
+    # guarded LOWER so a change cannot silently re-widen the cross
+    # stage back toward dense fan-out; the ICI column guards the
+    # intra-pod neighbor pool + fanout the same way. Auto-arm: skipped
+    # against histories that predate the fields (the PR 7 pattern).
+    "exchange_dcn_bytes_per_step": "lower",
+    "exchange_ici_bytes_per_step": "lower",
     # the closed-loop adaptive-rebalance leg's steady-state ms/step
     # under sustained drift bias (bench.py "rebalance" key <-
     # config4_drift.run_rebalance, loop ON): guards the whole
@@ -115,6 +125,8 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "stress_bw_util": ("stress", "bw_util"),
     "soak_pps": ("soak", "value"),
     "exchange_wire_bytes_per_step": ("report", "wire_bytes_per_step"),
+    "exchange_dcn_bytes_per_step": ("report", "dcn_bytes_per_step"),
+    "exchange_ici_bytes_per_step": ("report", "ici_bytes_per_step"),
     "rebalance_drift_ms": ("rebalance", "steady_ms_per_step"),
     "service_pps": ("service", "value"),
     "pipeline_pps": ("service", "pipeline_pps"),

@@ -22,6 +22,15 @@ per (engine, fraction) on stdout — same ``metric``/``value``/
 ``ms_per_step`` contract as the bench drivers, so telemetry.regress
 can diff captures.
 
+The hierarchical leg (ISSUE 19) re-runs the same sweep on a virtual
+2x(2,2,2)-pod mesh — grid (4, 2, 2) split into two pods along x — and
+times the flat sparse engine against the two-level schedule, reporting
+the per-domain split next to wall time: under the S004 billing
+discipline the flat engine's all_to_all crosses the pod boundary so its
+whole pool bills to DCN, while the two-level wire bills only the
+``(P-1) * cross_cap`` condensed per-destination-pod blocks there and
+keeps the neighbor blocks + fanout pool on ICI.
+
 Usage: python scripts/microbench_exchange_path.py [n_local] [steps]
 """
 from __future__ import annotations
@@ -185,8 +194,145 @@ def run(n_local: int = 1 << 13, steps: int = 30) -> list:
     return rows
 
 
+HIER_GRID = (4, 2, 2)  # 2 pods of (2, 2, 2) split along x
+HIER_DCN = (2, 1, 1)
+
+
+def run_hierarchical(n_local: int = 1 << 13, steps: int = 30) -> list:
+    """Flat-sparse vs two-level on the virtual 2x(2,2,2)-pod mesh at
+    1/5/25% movers (ISSUE 19). Both engines are asserted byte-identical
+    and fast-branch-only per step; the per-domain wire columns are the
+    scheduled-pool model (transport-independent, same formulas the api
+    journals as ``engine_cols_ici`` / ``engine_cols_dcn``)."""
+    import jax
+    import jax.numpy as jnp
+
+    grid = ProcessGrid(HIER_GRID)
+    hier = mesh_lib.HierarchicalMesh(grid, HIER_DCN)
+    R = grid.nranks
+    P, L = hier.n_pods, hier.pod_size
+    domain = Domain(0.0, 1.0, periodic=True)
+    sharded = len(jax.devices()) >= R
+    emesh = (
+        hier.build_mesh(list(jax.devices()[:R])) if sharded else None
+    )
+    n_act = sum(
+        1
+        for p in mesh_lib.neighbor_perms(
+            hier.local_grid, hier.local_periodic(tuple(domain.periodic))
+        )
+        if p
+    )
+    rng = np.random.default_rng(0)
+    base_cap = 1 << int(np.ceil(np.log2(2 * n_local / R)))
+    out_cap = 2 * n_local
+    rows = []
+    for frac in (0.01, 0.05, 0.25):
+        fused, count, peak = _state(grid, n_local, frac, rng)
+        # size the block from the measured peak and widen the dense
+        # pool if needed (the 16-rank grid's per-dest pool is narrow
+        # enough that 25% movers would otherwise clamp B into fallback)
+        B = 1 << int(np.ceil(np.log2(1.5 * peak)))
+        cap = max(base_cap, 2 * B)
+        # measured per-destination-POD peak sizes the cross block
+        sh = np.asarray(grid.shape)
+        peak_cross = 0
+        pod_of = np.asarray(hier.pod_of)
+        for r in range(R):
+            cells = np.floor(fused[r, :3].T * sh).astype(np.int64) % sh
+            flat = (
+                cells[:, 0] * sh[1] + cells[:, 1]
+            ) * sh[2] + cells[:, 2]
+            pods = pod_of[flat]
+            pods = pods[pods != pod_of[r]]
+            if pods.size:
+                peak_cross = max(
+                    peak_cross, int(np.bincount(pods).max())
+                )
+        B2 = max(2, 1 << int(np.ceil(np.log2(1.5 * peak_cross))))
+        if sharded:
+            fused_dev = jnp.asarray(
+                np.transpose(fused, (1, 0, 2)).reshape(K, R * n_local)
+            )
+        else:
+            fused_dev = jnp.asarray(fused)
+        count_dev = jnp.asarray(count)
+        ref_out = None
+        for engine in ("sparse", "hierarchical"):
+            if engine == "sparse":
+                f = (
+                    exchange.build_redistribute_count_driven(
+                        emesh, domain, grid, cap, out_cap, B, 3,
+                        engine="sparse", axes=hier.axis_names,
+                    )
+                    if sharded
+                    else exchange.build_redistribute_count_driven_vranks(
+                        domain, grid, cap, out_cap, B, 3, engine="sparse",
+                    )
+                )
+                # the flat pool's all_to_all crosses the pod boundary,
+                # so under the S004 billing discipline every scheduled
+                # column rides the DCN domain
+                cols_ici, cols_dcn = 0, R * B
+            else:
+                f = (
+                    exchange.build_redistribute_hierarchical(
+                        emesh, domain, grid, hier, cap, out_cap, B, B2, 3,
+                    )
+                    if sharded
+                    else exchange.build_redistribute_hierarchical_vranks(
+                        domain, grid, hier, cap, out_cap, B, B2, 3,
+                    )
+                )
+                cols_ici = n_act * B + (P - 1) * L * B2
+                cols_dcn = (P - 1) * B2
+            per_step, out = _time_calls(f, (fused_dev, count_dev), steps)
+            if engine == "sparse":
+                ref_out = np.asarray(out[0]).tobytes()
+            else:
+                assert np.asarray(out[0]).tobytes() == ref_out, (
+                    engine, frac, "engines diverged — not a benchmark",
+                )
+            st = out[2]
+            fb = np.asarray(st.fallback)
+            assert not fb.any(), (engine, frac, "fell back dense")
+            assert not np.asarray(st.dropped_send).any(), (
+                engine, frac, "cross block clipped — resize B2",
+            )
+            row = {
+                "metric": (
+                    f"exchange_hier_{engine}_f{int(frac*100):02d}"
+                ),
+                "value": round(1.0 / per_step, 2),
+                "unit": "calls/s",
+                "ms_per_step": round(per_step * 1e3, 4),
+                "engine": engine,
+                "layout": "sharded" if sharded else "vranks",
+                "pods": P,
+                "n_local": n_local,
+                "mover_fraction": frac,
+                "mover_cap": B,
+                "cross_cap": None if engine == "sparse" else B2,
+                "wire_bytes_per_step": float(
+                    (cols_ici + cols_dcn) * 4 * K * R
+                ),
+                "ici_bytes_per_step": float(cols_ici * 4 * K * R),
+                "dcn_bytes_per_step": float(cols_dcn * 4 * K * R),
+            }
+            rows.append(row)
+            common.log(
+                f"exchange_hier {engine} frac={frac:.0%}: "
+                f"{per_step*1e3:.3f} ms/call, "
+                f"dcn {row['dcn_bytes_per_step']/1e3:.1f} kB / "
+                f"ici {row['ici_bytes_per_step']/1e3:.1f} kB"
+            )
+    return rows
+
+
 if __name__ == "__main__":
     n_local = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 13
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
     for row in run(n_local, steps):
+        common.emit(row)
+    for row in run_hierarchical(n_local, steps):
         common.emit(row)

@@ -1,0 +1,406 @@
+"""Hierarchical two-level exchange (ISSUE 19): bit-identity vs the
+planar oracle across pod decompositions, routing + degradation reasons,
+cross-stage wire structure, and the S004 DCN-ratio gate.
+
+The two-level engine is an *engine*, not semantics: intra-pod rows ride
+the 3x3x3 neighbor ``ppermute`` schedule, boundary-crossing rows ride
+one condensed per-destination-pod block over a staged DCN hop plus an
+intra-pod fanout — and the result must be byte-identical to the dense
+planar exchange on every decomposition. What makes it worth having is
+structural (the DCN domain carries mover-count-driven bytes, never the
+dense fan-out), so that is asserted structurally on the jaxpr.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_grid_redistribute_tpu import api, telemetry
+from mpi_grid_redistribute_tpu.domain import Domain, ProcessGrid
+from mpi_grid_redistribute_tpu.parallel import exchange
+from mpi_grid_redistribute_tpu.parallel import mesh as mesh_lib
+
+
+def _inputs(shape, n_local, drift, rng, K=7):
+    """Shard-local particles plus a gaussian drift ([R, K, n] layout)."""
+    grid = ProcessGrid(shape=shape)
+    R = grid.nranks
+    pos = np.empty((R, 3, n_local), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        for a in range(3):
+            w = 1.0 / shape[a]
+            pos[r, a] = (cell[a] + rng.random(n_local)) * w
+    pos = pos + rng.normal(0, drift, size=pos.shape).astype(np.float32)
+    pos = np.mod(pos, 1.0).astype(np.float32)
+    other = rng.standard_normal((R, K - 3, n_local)).astype(np.float32)
+    fused = np.concatenate([pos, other], axis=1)
+    count = rng.integers(
+        n_local // 2, n_local + 1, size=R
+    ).astype(np.int32)
+    return grid, fused, count
+
+
+# (grid shape, dcn split) — both sharded cases split the 8-rank grid
+# into pods, including the non-cubic (1, 2, 2) and (2, 1, 1) pod shapes
+SHARDED_CASES = [
+    ((2, 2, 2), (2, 1, 1)),  # 2 pods of (1, 2, 2)
+    ((2, 2, 2), (1, 2, 2)),  # 4 pods of (2, 1, 1)
+]
+
+
+@pytest.mark.parametrize(
+    "shape,dcn", SHARDED_CASES, ids=["2pods-122", "4pods-211"]
+)
+def test_hierarchical_matches_planar_bitexact_sharded(
+    shape, dcn, rng, _devices
+):
+    grid, fused, count = _inputs(shape, 120, 0.01, rng)
+    R = grid.nranks
+    domain = Domain(lo=(0.0,) * 3, hi=(1.0,) * 3, periodic=(True,) * 3)
+    hier = mesh_lib.HierarchicalMesh(grid, dcn)
+    cap, out_cap, B, B2 = 60, 300, 16, 16
+    K = fused.shape[1]
+    fused_g = jnp.asarray(
+        np.transpose(fused, (1, 0, 2)).reshape(K, R * 120)
+    )
+    count_g = jnp.asarray(count)
+    mesh = mesh_lib.make_mesh(grid, jax.devices()[:R])
+    ref = exchange.build_redistribute_planar(
+        mesh, domain, grid, cap, out_cap, 3
+    )
+    out_p, cnt_p, st_p = ref(fused_g, count_g)
+    emesh = hier.build_mesh(list(jax.devices()[:R]))
+    f = exchange.shard_redistribute_hierarchical_sharded(
+        emesh, domain, grid, hier, cap, out_cap, B, B2, 3
+    )
+    out_h, cnt_h, st_h = jax.jit(f)(fused_g, count_g)
+    assert np.asarray(out_h).tobytes() == np.asarray(out_p).tobytes()
+    assert np.array_equal(np.asarray(cnt_h), np.asarray(cnt_p))
+    for name in ("send_counts", "recv_counts", "dropped_send",
+                 "dropped_recv", "needed_capacity"):
+        assert np.array_equal(
+            np.asarray(getattr(st_h, name)),
+            np.asarray(getattr(st_p, name)),
+        ), name
+    assert not np.asarray(st_h.fallback).any()
+    assert int(np.asarray(st_h.needed_cross).max()) <= B2
+
+    # vrank twin on the same decomposition: byte-equal to the planar
+    # vrank twin AND to the sharded global result
+    fused_v = jnp.asarray(fused)
+    ref_v = exchange.build_redistribute_planar_vranks(
+        domain, grid, cap, out_cap, 3
+    )
+    out_pv, cnt_pv, _ = ref_v(fused_v, count_g)
+    fv = jax.jit(
+        exchange.vrank_redistribute_hierarchical_fn(
+            domain, grid, hier, cap, out_cap, B, B2, 3
+        )
+    )
+    out_hv, cnt_hv, _ = fv(fused_v, count_g)
+    assert np.asarray(out_hv).tobytes() == np.asarray(out_pv).tobytes()
+    assert np.array_equal(np.asarray(cnt_hv), np.asarray(cnt_pv))
+    out_g = np.transpose(np.asarray(out_hv), (1, 0, 2)).reshape(
+        K, R * out_cap
+    )
+    assert out_g.tobytes() == np.asarray(out_p).tobytes()
+
+
+@pytest.mark.parametrize(
+    "shape,dcn",
+    [((2, 2, 4), (1, 1, 2)), ((3, 3, 3), (3, 1, 1))],
+    ids=["16vr-cubic-pod", "27vr-133-pod"],
+)
+def test_hierarchical_matches_planar_bitexact_vranks(shape, dcn, rng):
+    # more ranks than devices: the single-device vrank build, including
+    # a cubic (2, 2, 2) pod and the 27-rank non-pow2 grid
+    grid, fused, count = _inputs(shape, 48, 0.01, rng)
+    domain = Domain(lo=(0.0,) * 3, hi=(1.0,) * 3, periodic=(True,) * 3)
+    hier = mesh_lib.HierarchicalMesh(grid, dcn)
+    cap, out_cap, B, B2 = 32, 128, 8, 8
+    fused_v = jnp.asarray(fused)
+    count_g = jnp.asarray(count)
+    ref_v = exchange.build_redistribute_planar_vranks(
+        domain, grid, cap, out_cap, 3
+    )
+    out_p, cnt_p, _ = ref_v(fused_v, count_g)
+    fv = jax.jit(
+        exchange.vrank_redistribute_hierarchical_fn(
+            domain, grid, hier, cap, out_cap, B, B2, 3
+        )
+    )
+    out_h, cnt_h, st = fv(fused_v, count_g)
+    assert np.asarray(out_h).tobytes() == np.asarray(out_p).tobytes()
+    assert np.array_equal(np.asarray(cnt_h), np.asarray(cnt_p))
+    assert not np.asarray(st.dropped_send).any()
+
+
+# ------------------------------------------------------- wire structure
+
+from mpi_grid_redistribute_tpu.analysis.progcheck import (  # noqa: E402
+    walk_eqns,
+)
+from mpi_grid_redistribute_tpu.analysis.shardcheck import (  # noqa: E402
+    COLLECTIVE_PRIMS,
+    collective_axes,
+)
+
+
+def test_cross_pod_stage_has_no_dense_all_to_all(_devices):
+    """Every collective crossing a ``dcn_*`` axis is either a counts
+    exchange (all_to_all at counts scale) or the staged condensed-block
+    ``ppermute`` hop — never a payload-width all_to_all: the dense
+    fan-out must stay inside the pod."""
+    grid = ProcessGrid((2, 2, 2))
+    hier = mesh_lib.HierarchicalMesh(grid, (2, 1, 1))
+    domain = Domain(lo=(0.0,) * 3, hi=(1.0,) * 3, periodic=(True,) * 3)
+    R, cap, B, B2, K = 8, 64, 8, 8, 7
+    emesh = hier.build_mesh(list(jax.devices()[:R]))
+    f = exchange.shard_redistribute_hierarchical_sharded(
+        emesh, domain, grid, hier, cap, 256, B, B2, 3
+    )
+    jaxpr = jax.make_jaxpr(f)(
+        jnp.zeros((K, R * cap), jnp.float32),
+        jnp.zeros((R,), jnp.int32),
+    ).jaxpr
+    dcn_ppermutes = 0
+    for eqn in walk_eqns(jaxpr):
+        if eqn.primitive.name not in COLLECTIVE_PRIMS:
+            continue
+        axes = collective_axes(eqn)
+        if not any(a.startswith("dcn_") for a in axes):
+            continue
+        width = max(
+            int(np.prod(v.aval.shape)) for v in eqn.invars
+        )
+        if eqn.primitive.name == "ppermute":
+            # the staged hop ships the condensed per-destination-pod
+            # block: (P-1) blocks of B2 columns, K rows per shard
+            assert width <= K * (hier.n_pods - 1) * B2, (
+                f"DCN ppermute wider than the condensed block: {width}"
+            )
+            dcn_ppermutes += 1
+        else:
+            # counts-scale only ([P, L] exchanges, scalar reductions) —
+            # the dense pool is R * cap * K wide and must never cross
+            # DCN; in particular no payload all_to_all
+            assert width <= R * R, (
+                f"payload {eqn.primitive.name} crosses DCN: "
+                f"{width} elements"
+            )
+    assert dcn_ppermutes > 0, "staged DCN hop not found in the jaxpr"
+
+
+# ---------------------------------------------------------- API routing
+
+
+def _mk_rows(grid, n_local, drift, rng):
+    R = grid.nranks
+    pos = np.empty((R * n_local, 3), np.float32)
+    for r in range(R):
+        cell = grid.cell_of_rank(r)
+        for a in range(3):
+            w = 1.0 / grid.shape[a]
+            pos[r * n_local:(r + 1) * n_local, a] = (
+                cell[a] + rng.random(n_local)
+            ) * w
+    pos = np.mod(pos + rng.normal(0, drift, pos.shape), 1.0).astype(
+        np.float32
+    )
+    return pos, np.arange(R * n_local, dtype=np.int32)
+
+
+def _rd(shape, engine, **kw):
+    return api.GridRedistribute(
+        grid=shape, lo=(0.0,) * 3, hi=(1.0,) * 3,
+        periodic=(True,) * 3, engine=engine, **kw
+    )
+
+
+def _valid_rows(res, R):
+    """Per-rank valid row prefixes (robust to out_capacity deltas)."""
+    cnt = np.asarray(res.count)
+    pos = np.asarray(res.positions)
+    out_cap = pos.shape[0] // R
+    return [
+        pos[r * out_cap: r * out_cap + int(cnt[r])] for r in range(R)
+    ]
+
+
+def test_api_hierarchical_bitexact_and_reports_domains(rng, _devices):
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.02, rng)
+    rd_h = _rd((2, 2, 2), "hierarchical", dcn_shape=(2, 1, 1),
+               capacity=96, out_capacity=256)
+    rd_p = _rd((2, 2, 2), "planar", capacity=96, out_capacity=256)
+    res_h = rd_h.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    assert np.asarray(res_h.positions).tobytes() == np.asarray(
+        res_p.positions
+    ).tobytes()
+    assert np.array_equal(
+        np.asarray(res_h.count), np.asarray(res_p.count)
+    )
+    ev = [e for e in rd_h.telemetry.events()
+          if e.kind == "engine_resolved"]
+    assert ev[0].data["resolved"] == "hierarchical"
+    assert ev[0].data["reason"] == "explicit hierarchical two-level wire"
+    rep = rd_h.report()
+    assert rep["engine"] == "hierarchical"
+    assert rep["dcn_bytes_per_step"] > 0
+    assert rep["ici_bytes_per_step"] > 0
+    # the whole point: the DCN domain carries a sliver of the schedule
+    assert rep["dcn_bytes_per_step"] < rep["ici_bytes_per_step"]
+    assert (
+        rep["wire_bytes_per_step"]
+        == rep["dcn_bytes_per_step"] + rep["ici_bytes_per_step"]
+    )
+    assert rep["wire_bytes_per_step"] < rep["dense_wire_bytes_per_step"]
+    # runtime link reports stay consistent with the planar oracle's
+    flow_h = rd_h.flow()
+    flow_p = rd_p.flow()
+    assert np.array_equal(
+        np.asarray(flow_h["matrix"]), np.asarray(flow_p["matrix"])
+    )
+
+
+def test_api_auto_routes_hierarchical_on_multipod(rng, _devices):
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.02, rng)
+    rd_a = _rd((2, 2, 2), "auto", dcn_shape=(1, 2, 2))
+    rd_p = _rd((2, 2, 2), "planar")
+    res_a = rd_a.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    for a, b in zip(_valid_rows(res_a, 8), _valid_rows(res_p, 8)):
+        assert a.tobytes() == b.tobytes()
+    ev = [e for e in rd_a.telemetry.events()
+          if e.kind == "engine_resolved"]
+    assert ev[0].data["resolved"] == "hierarchical"
+    assert ev[0].data["reason"] == (
+        "auto: multi-pod mesh -> hierarchical two-level wire"
+    )
+
+
+@pytest.mark.parametrize("dcn", [None, (1, 1, 1)], ids=["none", "ones"])
+def test_api_hierarchical_flat_mesh_degrades_to_sparse(
+    dcn, rng, _devices
+):
+    # a flat mesh (no dcn domains) must degrade to the count-driven
+    # sparse engine with the journaled reason — never error
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.02, rng)
+    kw = {} if dcn is None else {"dcn_shape": dcn}
+    rd = _rd((2, 2, 2), "hierarchical", **kw)
+    rd_p = _rd((2, 2, 2), "planar")
+    res = rd.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    for a, b in zip(_valid_rows(res, 8), _valid_rows(res_p, 8)):
+        assert a.tobytes() == b.tobytes()
+    ev = [e for e in rd.telemetry.events()
+          if e.kind == "engine_resolved"]
+    assert ev[0].data["resolved"] == "sparse"
+    assert ev[0].data["reason"] == (
+        "hierarchical -> sparse: flat mesh (no dcn domains)"
+    )
+    assert rd.report()["engine"] == "sparse"
+
+
+def test_api_hierarchical_vranks_bitexact(rng, _devices):
+    # 16 ranks > 8 devices: the vmapped vrank build of the two-level
+    # engine, explicit opt-in, bit-identical to planar
+    grid = ProcessGrid((2, 2, 4))
+    pos, ids = _mk_rows(grid, 40, 0.01, rng)
+    rd_h = _rd((2, 2, 4), "hierarchical", dcn_shape=(1, 1, 2),
+               capacity=40, out_capacity=120)
+    rd_p = _rd((2, 2, 4), "planar", capacity=40, out_capacity=120)
+    res_h = rd_h.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    assert np.asarray(res_h.positions).tobytes() == np.asarray(
+        res_p.positions
+    ).tobytes()
+    assert rd_h.report()["engine"] == "hierarchical"
+
+
+def test_api_cross_cap_ratchets_from_measured_need(rng, _devices):
+    # cross_cap=1 + real cross-pod movers: the staged block clips, the
+    # retry loop ratchets the cap from stats.needed_cross (journaled as
+    # cross_cap_grow) and the healed result matches the planar oracle
+    grid = ProcessGrid((2, 2, 2))
+    pos, ids = _mk_rows(grid, 96, 0.05, rng)
+    rd = _rd((2, 2, 2), "hierarchical", dcn_shape=(2, 1, 1),
+             cross_cap=1, capacity=96)
+    rd_p = _rd((2, 2, 2), "planar", capacity=96)
+    res = rd.redistribute(pos, ids)
+    res_p = rd_p.redistribute(pos, ids)
+    for a, b in zip(_valid_rows(res, 8), _valid_rows(res_p, 8)):
+        assert a.tobytes() == b.tobytes()
+    assert rd._cross_cap > 1
+    grow = [e for e in rd.telemetry.events()
+            if e.kind == "cross_cap_grow"]
+    assert grow and grow[-1].data["new"] == rd._cross_cap
+    assert grow[-1].data["peak_cross"] >= grow[-1].data["old"]
+
+
+def test_resolve_two_phase_degrades_on_multipod():
+    rec = telemetry.StepRecorder()
+    two = exchange.resolve_two_phase(
+        "auto", chunk=4, planar_ok=True, ragged=False, vranks=True,
+        n_devices=1, n_pods=2, recorder=rec,
+    )
+    assert not two.armed
+    ev = [e for e in rec.events() if e.kind == "engine_resolved"]
+    assert ev[0].data["resolved"] == "sequential"
+    assert ev[0].data["reason"] == (
+        "pipeline: hierarchical multi-pod topology — sequential body"
+    )
+
+
+# --------------------------------------------------- S004 DCN-ratio gate
+
+
+def test_check_dcn_ratio_gate():
+    from mpi_grid_redistribute_tpu.analysis import rules_shard
+
+    def wires(hier_dcn, flat_dcn):
+        return {
+            "canonical_hierarchical_sharded": {
+                "per_domain": {"dcn": hier_dcn, "ici": 100},
+            },
+            "canonical_sparse_pods": {
+                "per_domain": {"dcn": flat_dcn, "ici": 0},
+            },
+        }
+
+    # within the gate: silent
+    assert rules_shard.check_dcn_ratio(wires(15, 100)) == []
+    # over the gate: one S004 finding naming both programs' bytes
+    out = rules_shard.check_dcn_ratio(wires(16, 100))
+    assert len(out) == 1 and out[0].rule == "S004"
+    assert "16" in out[0].message and "15%" in out[0].message
+    # vacuous denominator: loud, not silent
+    out = rules_shard.check_dcn_ratio(wires(0, 0))
+    assert len(out) == 1 and "vacuous" in out[0].message
+    # --programs subset without either side: skipped
+    assert rules_shard.check_dcn_ratio({"other": {}}) == []
+
+
+def test_committed_baseline_holds_the_dcn_ratio():
+    """The acceptance criterion itself, against the committed baseline:
+    hierarchical DCN bytes <= 15% of the flat sparse engine's cross-pod
+    bytes, as gated by ``make shardcheck``."""
+    from mpi_grid_redistribute_tpu.analysis import rules_shard
+    from mpi_grid_redistribute_tpu.analysis.baseline import (
+        load_wire_baseline,
+        progprofile_baseline_path,
+    )
+
+    wires = load_wire_baseline(progprofile_baseline_path())
+    assert "canonical_hierarchical_sharded" in wires
+    assert "canonical_sparse_pods" in wires
+    assert rules_shard.check_dcn_ratio(wires) == []
+    hier = wires["canonical_hierarchical_sharded"]["per_domain"]["dcn"]
+    flat = wires["canonical_sparse_pods"]["per_domain"]["dcn"]
+    assert 0 < hier <= 0.15 * flat

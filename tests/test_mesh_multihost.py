@@ -109,3 +109,85 @@ def test_shrink_to_fit_walks_the_shrink_ladder():
     assert mesh_lib.shrink_to_fit((4, 4), 5) == (2, 2)
     with pytest.raises(ValueError, match="cannot fit"):
         mesh_lib.shrink_to_fit((2, 2, 2), 0)
+
+
+# ----------------------------------------------- HierarchicalMesh (ISSUE 19)
+
+
+def test_make_hybrid_mesh_dcn_shape_defaults_to_none():
+    # the published signature: dcn_shape is optional and None means
+    # "flat" — callers must not need to spell out the all-ones tuple
+    import inspect
+
+    sig = inspect.signature(mesh_lib.make_hybrid_mesh)
+    param = sig.parameters["dcn_shape"]
+    assert param.default is None
+
+
+@pytest.mark.parametrize(
+    "dcn,msg",
+    [
+        ((2, 1), "must have 3 axes"),
+        ((0, 1, 1), ">= 1"),
+        ((3, 1, 1), "not divisible"),
+    ],
+    ids=["rank-mismatch", "nonpositive", "indivisible"],
+)
+def test_hierarchical_mesh_validates_dcn_shape(dcn, msg):
+    grid = ProcessGrid((2, 2, 2))
+    with pytest.raises(ValueError, match=msg):
+        mesh_lib.HierarchicalMesh(grid, dcn)
+
+
+def test_hierarchical_mesh_all_ones_is_flat():
+    grid = ProcessGrid((2, 2, 2))
+    hm = mesh_lib.HierarchicalMesh(grid, (1, 1, 1))
+    assert hm.n_pods == 1
+    assert hm.pod_size == grid.nranks
+    assert hm.dcn_axes == ()
+    assert hm.axis_names == grid.axis_names
+    assert hm.local_grid.shape == grid.shape
+    assert np.array_equal(hm.pod_of, np.zeros(8, np.int32))
+    assert np.array_equal(hm.local_of, np.arange(8, dtype=np.int32))
+
+
+def test_hierarchical_mesh_tables_2pods():
+    grid = ProcessGrid((2, 2, 2))
+    hm = mesh_lib.HierarchicalMesh(grid, (2, 1, 1))
+    assert hm.n_pods == 2
+    assert hm.pod_size == 4
+    assert hm.ici_shape == (1, 2, 2)
+    # interleaved expansion: the split axis becomes (dcn_x, x)
+    assert hm.axis_names == ("dcn_x", "x", "y", "z")
+    assert hm.axis_sizes == (2, 1, 2, 2)
+    assert hm.dcn_axes == ("dcn_x",)
+    assert hm.ici_axes == grid.axis_names
+    # row-major flat index over the expanded axes IS the grid rank —
+    # the bit-identity invariant the whole engine rests on
+    ranks = np.arange(grid.nranks).reshape(grid.shape)
+    assert np.array_equal(
+        ranks.reshape(hm.axis_sizes).reshape(-1),
+        np.arange(grid.nranks),
+    )
+    # pod/local tables are mutually consistent with the rank table
+    for r in range(grid.nranks):
+        assert hm.rank_table[hm.pod_of[r], hm.local_of[r]] == r
+    # each pod's ranks are strictly ascending (deterministic routing)
+    assert (np.diff(hm.rank_table, axis=1) > 0).all()
+    # periodicity only survives on axes a pod spans fully
+    assert hm.local_periodic((True, True, True)) == (False, True, True)
+    assert hm.local_periodic((False, True, False)) == (
+        False, True, False
+    )
+
+
+def test_hierarchical_mesh_build_mesh_expanded_axes(_devices):
+    import jax
+
+    grid = ProcessGrid((2, 2, 2))
+    hm = mesh_lib.HierarchicalMesh(grid, (2, 1, 1))
+    emesh = hm.build_mesh(list(jax.devices()[:8]))
+    assert emesh.axis_names == ("dcn_x", "x", "y", "z")
+    assert tuple(emesh.devices.shape) == (2, 1, 2, 2)
+    with pytest.raises(ValueError, match="needs 8 devices"):
+        hm.build_mesh(list(jax.devices()[:4]))
