@@ -26,10 +26,12 @@ bench-check:
 serve-metrics:
 	JAX_PLATFORMS=cpu $(PY) scripts/metrics_serve.py --demo --port 9100
 
-# grid observatory smoke: drift demo with the health monitor on, both
+# grid observatory smoke: drift demo with the health monitor on, three
 # legs on 8 virtual CPU devices. Balanced leg must stay OK (unexpected
 # ALERT = exit 1) and writes a Perfetto trace; biased leg must ALERT
-# (no alert = exit 2). See telemetry/SCHEMA.md.
+# (no alert = exit 2); corruption leg NaN-bursts a probed supervised
+# service run and must detect -> page -> bundle -> restore pre-
+# corruption (any broken link = exit 3). See telemetry/SCHEMA.md.
 observe:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
@@ -37,6 +39,9 @@ observe:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
 		--bias --expect-alert
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) examples/drift_demo.py --n 16384 --steps 20 \
+		--corrupt
 
 # service soak gate (bench/config8_soak.py --soak): short CPU soak of
 # the fault-tolerant service driver with the snapshot cadence on and

@@ -53,6 +53,16 @@ def main() -> None:
                     help="after the drift loop, run one ghost/overlap "
                          "exchange (rd.halo()) on the redistributed "
                          "state and print per-rank ghost counts")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="state-health observatory drill: run a short "
+                         "supervised service loop with the in-graph "
+                         "probes armed, NaN-burst the particle state "
+                         "mid-run, and exit non-zero unless the "
+                         "corruption is detected (state_health event), "
+                         "paged (nan_detected ALERT + incident bundle "
+                         "naming the step) and rolled back (restore "
+                         "from a pre-corruption snapshot); the third "
+                         "`make observe` leg")
     args = ap.parse_args()
 
     import jax
@@ -186,7 +196,88 @@ def main() -> None:
         print("unexpected ALERT on a balanced workload")
         sys.exit(1)
 
-    # --- 2c. optional halo/ghost exchange (the public halo API) ---------
+    # --- 2c. state-health observatory drill (--corrupt) -----------------
+    if args.corrupt:
+        import shutil
+        import tempfile
+
+        from mpi_grid_redistribute_tpu.service import (
+            DriverConfig,
+            FaultPlan,
+            RestartPolicy,
+            ServiceDriver,
+            StateCorruptionFault,
+            Supervisor,
+        )
+        from mpi_grid_redistribute_tpu.telemetry import (
+            incident as incident_lib,
+        )
+
+        # numpy backend: the drill exercises the observatory loop
+        # (probe -> ALERT -> bundle -> restore), not the device mesh
+        root = tempfile.mkdtemp(prefix="drift_corrupt_")
+        try:
+            rec2 = telemetry.StepRecorder()
+            svc_cfg = DriverConfig(
+                grid_shape=grid_shape, n_local=256, steps=24, seed=7,
+                backend="numpy", snapshot_every=4,
+                snapshot_dir=os.path.join(root, "snaps"),
+                probes="counters",
+                incident_dir=os.path.join(root, "incidents"),
+            )
+            plan = FaultPlan([StateCorruptionFault(6, rows=5)])
+            sup = Supervisor(
+                lambda: ServiceDriver(svc_cfg, recorder=rec2, faults=plan),
+                policy=RestartPolicy(
+                    backoff_base_s=0.01, backoff_cap_s=0.02
+                ),
+                recorder=rec2,
+                sleep_fn=lambda s: None,
+            )
+            sv = sup.run()
+            nan_steps = sorted(
+                e.data["step"] for e in rec2.events("state_health")
+                if e.data.get("nan_pos") or e.data.get("nan_vel")
+            )
+            alerts = [
+                e for e in rec2.events("alert")
+                if e.data.get("rule") == "nan_detected"
+            ]
+            restores = [
+                e for e in rec2.events("restore")
+                if e.data.get("what") == "state"
+            ]
+            bundles = incident_lib.list_bundles(svc_cfg.incident_dir)
+            checks = {
+                "probes saw the NaN burst": bool(nan_steps),
+                "nan_detected paged": bool(alerts),
+                "incident bundle names the step": any(
+                    b.get("rule") == "nan_detected"
+                    and nan_steps
+                    and f"step {nan_steps[0]}" in str(b.get("reason", ""))
+                    for b in bundles
+                ),
+                "restored pre-corruption snapshot": bool(
+                    restores and nan_steps
+                    and int(restores[-1].data["step"]) < nan_steps[0]
+                ),
+                "recovered in one restart": bool(
+                    sv.ok and sv.restarts == 1 and sv.step == svc_cfg.steps
+                ),
+            }
+            print("\ncorruption drill (NaN burst at a probed step):")
+            for name, ok in checks.items():
+                print(f"  {'ok' if ok else 'FAIL'}  {name}")
+            if nan_steps:
+                print(f"  corruption entered at step {nan_steps[0]}, "
+                      f"restored to step "
+                      f"{restores[-1].data['step'] if restores else '?'}")
+            if not all(checks.values()):
+                sys.exit(3)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # --- 2d. optional halo/ghost exchange (the public halo API) ---------
     if args.halo:
         # ghosts for the owner-placed state from step 1: every shard
         # receives copies of neighbor particles within `width` of its

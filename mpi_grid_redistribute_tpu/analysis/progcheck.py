@@ -421,9 +421,12 @@ def _migrate_build(engine: str, topology: str):
     return build
 
 
-def _resident_build():
+def _resident_build(probe_tier=None):
     """Builder for the resident chunk macro-step — the exact jitted
-    ``lax.scan`` program ``ServiceDriver`` dispatches per chunk."""
+    ``lax.scan`` program ``ServiceDriver`` dispatches per chunk. With
+    ``probe_tier`` set, builds the probe-armed variant (ISSUE 20): the
+    state-health summaries ride the scan ys, so J002 pins them to the
+    pure in-graph path (no callbacks/infeed smuggled in)."""
 
     def build():
         import jax.numpy as jnp
@@ -436,8 +439,15 @@ def _resident_build():
         vel = jnp.zeros((R * _N_LOCAL, 3), jnp.float32)
         ids = jnp.zeros((R * _N_LOCAL,), jnp.int32)
         count = jnp.full((R,), _N_LOCAL, jnp.int32)
+        kwargs = {}
+        if probe_tier is not None:
+            from mpi_grid_redistribute_tpu.telemetry.probes import (
+                ProbeConfig,
+            )
+
+            kwargs["probes"] = ProbeConfig(tier=probe_tier)
         macro, _cap, _out_cap = resident.make_chunk_fn(
-            rd, 0.05, 4, pos, vel, ids
+            rd, 0.05, 4, pos, vel, ids, **kwargs
         )
         assert getattr(
             macro.__wrapped__, "_progcheck_resident", False
@@ -583,6 +593,20 @@ def _register_defaults() -> None:
     )
     register_program(
         ProgramSpec(
+            name="resident_macro_step_probed",
+            build=_resident_build(probe_tier="counters"),
+            description="service/resident.py chunk macro-step with the "
+            "counters-tier state-health probe pass (ISSUE 20) folded "
+            "into the scan ys — live/NaN/OOB/residual summaries ride "
+            "the same chunk-boundary transfer as the stats",
+            engine="planar",
+            topology="vranks",
+            resident=True,
+            tags=("resident", "probes"),
+        )
+    )
+    register_program(
+        ProgramSpec(
             name="pipelined_macro_step",
             build=_pipeline_build(),
             description="service/pipeline.py software-pipelined chunk "
@@ -648,7 +672,13 @@ def registry_coverage(
                     "COUNT_DRIVEN_ENGINES) has no registered program",
                 )
             )
-    for tag in ("resident", "pipeline", "migrate", "apply_assignment"):
+    for tag in (
+        "resident",
+        "pipeline",
+        "migrate",
+        "apply_assignment",
+        "probes",
+    ):
         if not any(tag in p.tags for p in programs.values()):
             findings.append(
                 ProgFinding(

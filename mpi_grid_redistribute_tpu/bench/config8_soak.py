@@ -16,6 +16,14 @@ story depends on:
   the latest snapshot, and byte-compares the final state against an
   uninterrupted run — ``bit_identical_resume`` in the capture, gated by
   ``make soak``.
+* **Does the observatory catch corrupted physics?** The corruption leg
+  (ISSUE 20) soaks with the state-health probes armed and injects a NaN
+  burst (:class:`~..service.faults.StateCorruptionFault`): the run must
+  end ALERT → restart → restore — a ``state_health`` event with a
+  nonzero nan count, a ``nan_detected`` ALERT, an incident bundle whose
+  index names the corruption step, exactly one restart, and a restore
+  to a PRE-corruption snapshot — ``corruption_recovered`` in the
+  capture, gated by ``make soak``.
 * **Does recovery survive losing devices?** The elastic leg (ISSUE 8)
   crashes mid-run AND reports only half the devices on restart
   (:class:`~..service.faults.DeviceLossFault`): the supervisor must
@@ -62,7 +70,8 @@ def _grid_and_backend():
 
 
 def _make_driver(grid, backend, n_local, steps, snapshot_every, snap_dir,
-                 recorder=None, faults=None):
+                 recorder=None, faults=None, probes="off",
+                 incident_dir=None):
     from mpi_grid_redistribute_tpu.service import DriverConfig, ServiceDriver
 
     cfg = DriverConfig(
@@ -74,6 +83,8 @@ def _make_driver(grid, backend, n_local, steps, snapshot_every, snap_dir,
         snapshot_every=snapshot_every,
         snapshot_dir=snap_dir,
         keep_snapshots=3,
+        probes=probes,
+        incident_dir=incident_dir,
     )
     return ServiceDriver(cfg, recorder=recorder, faults=faults)
 
@@ -194,6 +205,67 @@ def run(n_local: int = None, reps: int = None) -> dict:
         resharded = len(rec2.events("reshard"))
         elastic_grid = list(sup2.driver.cfg.grid_shape)
         elastic_restarts = verdict2.restarts
+
+        # --- corruption leg (ISSUE 20): NaN burst at step k with the
+        # state-health probes armed. The observatory must close the
+        # whole loop: a state_health event with a nonzero nan count, a
+        # nan_detected ALERT, an incident bundle whose index names the
+        # corruption step, one StateCorruptionError restart, and a
+        # supervised restore to a PRE-corruption snapshot (the boundary
+        # gate raises before the snapshot hook, so the newest snapshot
+        # always predates the damage).
+        from mpi_grid_redistribute_tpu.service import StateCorruptionFault
+        from mpi_grid_redistribute_tpu.telemetry import incident as _inc
+
+        corrupt_at = crash_at
+        inc_dir = os.path.join(root, "corrupt_incidents")
+        rec3 = StepRecorder()
+        plan3 = FaultPlan([StateCorruptionFault(corrupt_at, rows=8)])
+        sup3 = Supervisor(
+            lambda: _make_driver(
+                grid, backend, n_small, crash_steps, crash_every,
+                os.path.join(root, "corrupt_snaps"), recorder=rec3,
+                faults=plan3, probes="counters", incident_dir=inc_dir,
+            ),
+            policy=RestartPolicy(backoff_base_s=0.01, backoff_cap_s=0.05),
+            recorder=rec3,
+        )
+        verdict3 = sup3.run()
+        nan_steps = sorted(
+            e.data["step"]
+            for e in rec3.events("state_health")
+            if e.data.get("nan_pos") or e.data.get("nan_vel")
+        )
+        nan_alerts = [
+            e for e in rec3.events("alert")
+            if e.data.get("rule") == "nan_detected"
+        ]
+        restores3 = [
+            e for e in rec3.events("restore")
+            if e.data.get("what") == "state"
+        ]
+        # the restore must land strictly before the step the NaNs hit
+        restored_pre = bool(
+            restores3
+            and nan_steps
+            and int(restores3[-1].data["step"]) < nan_steps[0]
+        )
+        step_named = any(
+            idx.get("rule") == "nan_detected"
+            and nan_steps
+            and f"step {nan_steps[0]}" in str(idx.get("reason", ""))
+            for idx in _inc.list_bundles(inc_dir)
+        )
+        corruption_recovered = bool(
+            verdict3.ok
+            and verdict3.restarts == 1
+            and nan_steps
+            and nan_alerts
+            and restored_pre
+            and step_named
+        )
+        corruption_restarts = verdict3.restarts
+        corruption_step = nan_steps[0] if nan_steps else None
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -217,6 +289,9 @@ def run(n_local: int = None, reps: int = None) -> dict:
         "elastic_grid": elastic_grid,
         "elastic_set_identical": elastic_set_identical,
         "resharded": resharded,
+        "corruption_restarts": corruption_restarts,
+        "corruption_step": corruption_step,
+        "corruption_recovered": corruption_recovered,
     }
     common.log(
         f"config8: soak {live / soak['min']:.3e} pps "
@@ -225,7 +300,9 @@ def run(n_local: int = None, reps: int = None) -> dict:
         f"crash leg: restarts={verdict.restarts} "
         f"bit_identical={bit_identical}, "
         f"elastic leg: grid {list(grid)}->{elastic_grid} "
-        f"resharded={resharded} set_identical={elastic_set_identical}"
+        f"resharded={resharded} set_identical={elastic_set_identical}, "
+        f"corruption leg: nan at step {corruption_step} "
+        f"restarts={corruption_restarts} recovered={corruption_recovered}"
     )
     return out
 
@@ -263,6 +340,18 @@ def _soak_gate(out: dict, overhead_max: float = 0.02) -> list:
         failures.append(
             "elastic leg journaled no reshard event (restore never "
             "re-decomposed the snapshot)"
+        )
+    if not out["corruption_recovered"]:
+        failures.append(
+            "corruption leg did not close the observatory loop "
+            "(expected: nan state_health event -> nan_detected ALERT -> "
+            "bundle naming the step -> one restart -> pre-corruption "
+            "restore -> healthy finish)"
+        )
+    if out["corruption_restarts"] != 1:
+        failures.append(
+            f"corruption leg restarted {out['corruption_restarts']} "
+            f"times, expected 1"
         )
     return failures
 

@@ -34,6 +34,8 @@ from mpi_grid_redistribute_tpu.service.faults import (
     SLOBreachError,
     StallError,
     StallFault,
+    StateCorruptionError,
+    StateCorruptionFault,
     TornSnapshotFault,
 )
 from mpi_grid_redistribute_tpu.service.supervisor import (
@@ -57,6 +59,8 @@ __all__ = [
     "ServiceDriver",
     "StallError",
     "StallFault",
+    "StateCorruptionError",
+    "StateCorruptionFault",
     "Supervisor",
     "SupervisorVerdict",
     "TornSnapshotFault",

@@ -53,6 +53,11 @@ from mpi_grid_redistribute_tpu.service.faults import FaultPlan, StallError
 from mpi_grid_redistribute_tpu.telemetry import StepRecorder
 from mpi_grid_redistribute_tpu.telemetry import context as context_lib
 from mpi_grid_redistribute_tpu.telemetry.health import HealthMonitor
+from mpi_grid_redistribute_tpu.telemetry.probes import (
+    ProbeConfig,
+    record_probe_steps,
+    summarize_host,
+)
 from mpi_grid_redistribute_tpu.telemetry.profiler import ProfilerSession
 from mpi_grid_redistribute_tpu.utils import checkpoint
 
@@ -100,6 +105,17 @@ class DriverConfig:
     # topology) degrade to the sequential body, journaled as
     # engine_resolved; chunk auto-split rules are unchanged.
     pipeline: bool = False
+    # state-health observatory (ISSUE 20): probe tier folded into the
+    # resident/pipelined macro-step ("off" | "counters" | "moments",
+    # telemetry/probes.py). Armed tiers journal one `state_health`
+    # event per step (NaN/Inf, out-of-bounds and conservation-ledger
+    # counters; "moments" adds extents and the velocity second moment)
+    # and any nonzero corruption counter fails the NEXT chunk boundary
+    # with StateCorruptionError BEFORE the snapshot hook — the newest
+    # snapshot always predates the corruption, so the supervisor's
+    # restore rolls the damage back. "off" is bit-identical zero-cost:
+    # the builders emit the exact unprobed program.
+    probes: str = "off"
     # elastic restore (ISSUE 8): re-shard a snapshot whose (nranks,
     # rows_per_shard) disagrees with this config onto the configured
     # grid in one canonical redistribute; off = clear ElasticRestoreError
@@ -221,6 +237,13 @@ class ServiceDriver:
         # dispatched before chunk k's host reads (async overlap)
         self._chunk_cache = {}
         self._chunk_done: Optional[float] = None
+        # state-health observatory (ISSUE 20): the static probe config
+        # (validates cfg.probes eagerly; joins the macro cache key) and
+        # the breach latch a probed chunk sets when any corruption
+        # counter is nonzero — consumed by _state_health_gate at the
+        # NEXT boundary, before the snapshot hook
+        self._probes = ProbeConfig(tier=cfg.probes)
+        self._state_breach = False
         self._install_slo_rules()
         self._install_rebalance_rule()
         self._flight = self._install_flight_recorder()
@@ -873,6 +896,7 @@ class ServiceDriver:
         key = (
             n, pos.shape[0], rd.capacity, rd.out_capacity,
             rd._mover_cap, rd.edges, self.engine, pipelined,
+            self._probes,
         )
         entry = self._chunk_cache.get(key)
         if entry is None:
@@ -881,7 +905,9 @@ class ServiceDriver:
                 if pipelined
                 else resident.make_chunk_fn
             )
-            entry = build(rd, self.cfg.dt, n, pos, vel, ids)
+            entry = build(
+                rd, self.cfg.dt, n, pos, vel, ids, probes=self._probes
+            )
             self._chunk_cache[key] = entry
         return entry
 
@@ -928,6 +954,46 @@ class ServiceDriver:
                 f"(> {cfg.watchdog_s:.3f}s watchdog)"
             )
 
+    def _note_probe_steps(self, probe) -> None:
+        """Journal one ``state_health`` event per probed step (from
+        already-fetched host arrays) and latch the breach flag when any
+        corruption counter is nonzero. The latch — not the raw events —
+        is what :meth:`_state_health_gate` consumes, so the steady-state
+        per-boundary cost of an armed probe is a few comparisons on
+        chunk-length arrays, never a full rule evaluation."""
+        record_probe_steps(self.recorder, self.step + 1, probe)
+        for k in ("nan_pos", "nan_vel", "oob", "residual"):
+            if np.asarray(probe[k]).any():
+                self._state_breach = True
+                break
+
+    def _state_health_gate(self) -> None:
+        # corruption fails the boundary BEFORE the snapshot hook: a
+        # snapshot taken now would freeze the corrupt state, and the
+        # supervisor's restore would then faithfully bring the damage
+        # back. Raising first keeps the newest snapshot pre-corruption.
+        if not self._state_breach:
+            return
+        from mpi_grid_redistribute_tpu.service.faults import (
+            _STATE_RULES,
+            StateCorruptionError,
+        )
+
+        self._state_breach = False
+        # evaluate() journals the nan_detected / conservation_drift /
+        # bounds_violation ALERT and fires the flight recorder callback,
+        # so the incident bundle freezes before the raise tears us down
+        verdict = self.monitor.evaluate()
+        reasons = [
+            f"{f['rule']}: {f['reason']}"
+            for f in verdict["findings"]
+            if f["rule"] in _STATE_RULES
+        ]
+        raise StateCorruptionError(
+            "; ".join(reasons)
+            or "state_health breach (events evicted before the gate)"
+        )
+
     def _run_boundary(self) -> None:
         # snapshot/health hooks, on the step the chunk just ended at;
         # _chunk_len_from guarantees chunks never straddle a boundary
@@ -937,6 +1003,7 @@ class ServiceDriver:
         if self._flight is not None:
             self._flight.scan_faults()
         try:
+            self._state_health_gate()
             if cfg.snapshot_every and self.step % cfg.snapshot_every == 0:
                 self._materialize_state()
                 path = self.snapshot()
@@ -964,10 +1031,33 @@ class ServiceDriver:
         if fire_faults:
             self.faults.before_step(self)
         self._materialize_state()
+        armed = self._probes.armed
+        if armed:
+            # per-chunk conservation ledger, same anchoring as the
+            # resident scan: initial live rows at chunk entry, dropped
+            # rows accumulated per step — so a step executed eagerly
+            # (fault chunk, overflow re-run, numpy backend) journals
+            # counter-exact state_health events
+            live0 = int(np.asarray(self.state[3]).sum())
+            cum = 0
         dropped = []
-        for _ in range(n):
+        for i in range(n):
             self.state = self._advance(*self.state)
             dropped.append(self._last_dropped)
+            if armed:
+                cum += self._last_dropped
+                pos, vel, _, count = self.state
+                payload = summarize_host(
+                    pos, vel, count, live0, cum, self._probes
+                )
+                self.recorder.record(
+                    "state_health", step=self.step + 1 + i, **payload
+                )
+                if (
+                    payload["nan_pos"] or payload["nan_vel"]
+                    or payload["oob"] or payload["residual"]
+                ):
+                    self._state_breach = True
         compute = time.perf_counter() - t0
         if cfg.step_sleep:
             time.sleep(cfg.step_sleep * n)
@@ -1059,6 +1149,12 @@ class ServiceDriver:
                 out_capacity=out_cap,
                 engine=wire.get("engine", self.engine),
                 wire_bytes=wire_bytes,
+            )
+        probe = ys.get("probe")
+        if probe is not None:
+            # tiny host reads, same transfer contract as the stats ys
+            self._note_probe_steps(
+                {k: np.asarray(v) for k, v in probe.items()}
             )
         dropped = (ds.sum(axis=1) + dr.sum(axis=1)).tolist()
         self._finish_steps(n, compute, budget, dropped)
@@ -1219,6 +1315,13 @@ def main(argv=None) -> int:
              "when the schedule is infeasible)",
     )
     p.add_argument(
+        "--probes", default="off", choices=("off", "counters", "moments"),
+        help="in-graph state-health probe tier (telemetry/probes.py): "
+             "journal per-step state_health events and fail the chunk "
+             "boundary on NaN / out-of-bounds / conservation drift "
+             "(off = bit-identical unprobed program)",
+    )
+    p.add_argument(
         "--no-resume", action="store_true",
         help="ignore existing snapshots; start from the seeded state",
     )
@@ -1311,6 +1414,7 @@ def main(argv=None) -> int:
         step_sleep=args.step_sleep,
         chunk=args.chunk,
         pipeline=args.pipeline,
+        probes=args.probes,
         auto_reshard=not args.no_reshard,
         slo_latency_p99_s=args.slo_p99,
         rebalance=args.rebalance,

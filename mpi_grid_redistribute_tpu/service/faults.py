@@ -41,6 +41,14 @@ ISSUE 8 adds the elastic pair:
 * :class:`DeviceLossFault` — answer the driver's restore-time
   ``device_budget`` query with M < R survivors, forcing a shrink-to-fit
   re-shard of the snapshot (journaled ``reshard``).
+
+ISSUE 20 adds the physics-corruption leg:
+
+* :class:`StateCorruptionFault` — NaN-burst live position rows at a
+  step; the armed state-health probes must detect it within the chunk
+  (``nan_detected`` ALERT + incident bundle) and the boundary gate must
+  raise :class:`StateCorruptionError` before the snapshot hook, so the
+  supervised restore rolls the corruption back.
 """
 # gridlint: service-path
 
@@ -67,6 +75,22 @@ class SLOBreachError(RuntimeError):
     step-latency or dropped-rows over the configured window). Raised out
     of the run loop so the supervisor treats it as a restartable failure
     — and, on repeat, as the trigger for a mesh shrink."""
+
+
+#: Health rules whose ALERT the state-health boundary gate converts into
+#: a :class:`StateCorruptionError` (ISSUE 20; telemetry/health.py).
+_STATE_RULES = ("nan_detected", "conservation_drift", "bounds_violation")
+
+
+class StateCorruptionError(RuntimeError):
+    """An armed state-health probe (``DriverConfig.probes``) found
+    corruption — NaN/Inf components, out-of-bounds positions, or a
+    nonzero conservation residual — in the particle state. Raised at the
+    chunk boundary BEFORE the snapshot hook, so the newest snapshot
+    always predates the corruption and the supervisor's restore rolls
+    the damage back instead of faithfully preserving it. Restartable,
+    like :class:`SLOBreachError`, but never feeds the shrink policy:
+    corrupt state is not a capacity problem."""
 
 
 class CrashFault:
@@ -290,6 +314,49 @@ class LatencySpikeFault:
         return max(step, self.start_step)
 
 
+class StateCorruptionFault:
+    """NaN-burst the particle state at ``step`` (ISSUE 20): overwrite
+    the position components of the first ``rows`` LIVE rows of shard 0
+    with NaN — silent data corruption (bad kernel, cosmic ray, host DMA
+    fault) that no system-level signal catches. With
+    ``DriverConfig.probes`` armed, the next ``state_health`` event must
+    show a nonzero ``nan_pos`` count, the ``nan_detected`` rule must
+    ALERT (freezing an incident bundle that names the step), and the
+    boundary gate must raise :class:`StateCorruptionError` BEFORE the
+    snapshot hook — so the supervisor restores a pre-corruption
+    snapshot. The injector fires once (``fired``), so the restored
+    attempt proves recovery instead of re-corrupting forever."""
+
+    kind = "state_corruption"
+
+    def __init__(self, step: int, rows: int = 4):
+        if rows < 1:
+            raise ValueError(f"rows must be >= 1, got {rows}")
+        self.step = int(step)
+        self.rows = int(rows)
+        self.fired = False
+
+    def before_step(self, driver) -> None:
+        if self.fired or driver.step != self.step:
+            return
+        self.fired = True
+        driver.recorder.record(
+            "fault_injected", fault=self.kind, step=driver.step,
+            rows=self.rows,
+        )
+        driver._materialize_state()
+        pos, vel, ids, count = driver.state
+        pos = np.array(pos, copy=True)
+        k = min(self.rows, int(count[0]))
+        pos[:k] = np.nan  # head rows of shard 0 are live (prefix layout)
+        driver.state = (pos, vel, ids, count)
+
+    def next_step(self, step: int) -> Optional[int]:
+        if self.fired or self.step < step:
+            return None
+        return self.step
+
+
 class DeviceLossFault:
     """On restart, the mesh reports only ``devices`` survivors (M < R).
 
@@ -413,6 +480,8 @@ class FaultPlan:
                 faults.append(FallbackFloodFault(at))
             elif kind == "latency_spike":
                 faults.append(LatencySpikeFault(at))
+            elif kind == "state_corruption":
+                faults.append(StateCorruptionFault(at))
             elif kind == "device_loss":
                 faults.append(DeviceLossFault(1))
             else:

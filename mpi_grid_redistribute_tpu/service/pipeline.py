@@ -57,7 +57,7 @@ from jax import lax
 
 from mpi_grid_redistribute_tpu import api
 from mpi_grid_redistribute_tpu.models import nbody
-from mpi_grid_redistribute_tpu.ops import binning, pack
+from mpi_grid_redistribute_tpu.ops import binning, pack, statehealth
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 from mpi_grid_redistribute_tpu.parallel import exchange, migrate
 from mpi_grid_redistribute_tpu.service import resident
@@ -79,7 +79,8 @@ def _drift_compatible(specs, ndim):
     )
 
 
-def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
+def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8,
+                            probes=None):
     """Build the software-pipelined jitted macro-step (ISSUE 12).
 
     Drop-in sibling of :func:`..service.resident.make_chunk_fn` — same
@@ -117,6 +118,16 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
       ``dropped_send`` (backlog) so the driver's discard + eager re-run
       path neutralizes the semantic difference; a committed chunk had
       every mover granted and nothing dropped in BOTH engines.
+    - with ``probes`` armed, the NaN/OOB/moment scans run over the
+      fused state at each step's ISSUE point (post-drift,
+      pre-exchange; step k's arrivals are scanned at step k+1's
+      issue), while ``live`` and the conservation ``residual`` come
+      from the exact post-step counts ``_step_ys`` already computes —
+      so the counters match the sequential probe exactly and a NaN
+      row is detected at most one in-chunk step later. The ledger
+      counts only ``dropped_recv`` here: this engine's
+      ``dropped_send`` is withheld-but-resident backlog, not
+      destroyed rows (``ops/statehealth.py``).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -153,13 +164,27 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
     )
     if not handle.armed:
         return resident.make_chunk_fn(
-            rd, dt, chunk, positions, *fields, unroll=unroll
+            rd, dt, chunk, positions, *fields, unroll=unroll,
+            probes=probes,
         )
     tp = handle.bundle
     V, n = tp.vranks, tp.n_local
     D = rd.domain.ndim
     KP = sum(s[2] for s in specs)  # payload rows (alive row rides last)
     dt = float(dt)
+    armed = probes is not None and probes.armed
+
+    def _probe(T, count, live0, cum):
+        """Step summary from the fused planar state at issue time:
+        positions/velocities bitcast back to f32 rows, liveness from
+        the alive row, the exact end-of-step live total from the ys
+        ``count`` the caller just computed."""
+        p = lax.bitcast_convert_type(T[:D], jnp.float32).T
+        v = lax.bitcast_convert_type(T[D : 2 * D], jnp.float32).T
+        return statehealth.summarize_masked(
+            p, v, T[-1] > 0, jnp.sum(count), live0, cum,
+            probes.lo, probes.hi, probes.tier,
+        )
 
     def _drift(fused):
         """Drift the planar matrix in place of layout: position rows
@@ -271,12 +296,22 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
         alive0 = ((gcol % n) < count[gcol // n]).astype(jnp.int32)
         work = jnp.concatenate([fused_p, alive0[None]], axis=0)
         st = migrate.init_state(work, vranks=V, batched=True)
+        live0 = jnp.sum(count).astype(jnp.int32)
         # prologue: step 1's drift + issue (nothing in flight yet)
         T = _drift(st.fused)
         plan, arr, ys1, feas = _issue_tail(T, st.n_free)
+        cum0 = jnp.int32(0)
+        if armed:
+            cum0 = statehealth.step_dropped(
+                ys1["stats"], pipelined=True
+            )
+            ys1["probe"] = _probe(T, ys1["count"], live0, cum0)
 
         def body(carry, _):
-            T, stack, nf, arr, vac, ns, ni, feas = carry
+            if armed:
+                T, stack, nf, arr, vac, ns, ni, feas, cum = carry
+            else:
+                T, stack, nf, arr, vac, ns, ni, feas = carry
             with traced_span("pipe:land+drift"):
                 T2, stack2, nf2, key2 = lax.cond(
                     feas,
@@ -292,12 +327,21 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
                 T2, stack2, nf2, arr2,
                 plan2.vacated, plan2.n_sent, plan2.n_in, feas2,
             )
+            if armed:
+                with traced_span("pipe:probe"):
+                    cum = cum + statehealth.step_dropped(
+                        ys["stats"], pipelined=True
+                    )
+                    ys["probe"] = _probe(T2, ys["count"], live0, cum)
+                carry2 = carry2 + (cum,)
             return carry2, ys
 
         carry = (
             T, st.free_stack, st.n_free, arr,
             plan.vacated, plan.n_sent, plan.n_in, feas,
         )
+        if armed:
+            carry = carry + (cum0,)
         carry, ys_rest = lax.scan(
             body, carry, None, length=chunk - 1, unroll=1
         )
@@ -308,7 +352,7 @@ def make_pipelined_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
         )
         # epilogue: land step `chunk` (already drifted at issue time —
         # no further drift) and compact the resident slots once
-        T, stack, nf, arr, vac, ns, ni, _ = carry
+        T, stack, nf, arr, vac, ns, ni = carry[:7]
         Tf, _, _, _ = tp.land(T, stack, nf, arr, vac, ns, ni)
         alive = (Tf[-1] > 0).reshape(V, n)
         perm = jnp.argsort(

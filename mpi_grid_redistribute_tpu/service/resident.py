@@ -47,9 +47,11 @@ otherwise (``DriverConfig.pipeline`` selects between them).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from mpi_grid_redistribute_tpu.models import nbody
+from mpi_grid_redistribute_tpu.ops import statehealth
 from mpi_grid_redistribute_tpu.telemetry import context as context_lib
 from mpi_grid_redistribute_tpu.telemetry.phases import traced_span
 
@@ -66,7 +68,8 @@ class ResidentLayoutError(ValueError):
     provoked the rebuild."""
 
 
-def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
+def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8,
+                  probes=None):
     """Build the jitted macro-step for ``chunk`` service steps.
 
     Args:
@@ -84,11 +87,22 @@ def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
         op sequence per step is identical, only the loop structure
         differs, so bit-identity with the eager loop is preserved
         (and re-checked by the chunk-vs-eager audits).
+      probes: optional :class:`~..telemetry.probes.ProbeConfig`. When
+        armed, each scanned step additionally folds an in-graph
+        state-health summary (``ops/statehealth.py``: live rows,
+        NaN/Inf counts, out-of-bounds positions, the exact int32
+        conservation residual, moment extents one tier up) into the ys
+        under ``"probe"``, with the conservation ledger carried as one
+        extra int32 scalar in the scan carry. ``None`` / tier ``off``
+        emits the EXACT unprobed program — bit-identical by jaxpr
+        equality (``tests/test_probes.py``), so the default tier is
+        zero-cost, not merely cheap.
 
     Returns ``(macro, cap, out_cap)`` where
     ``macro(pos, vel, ids, count) -> ((pos, vel, ids, count), ys)`` and
     ``ys = {"stats": RedistributeStats[chunk, ...], "count":
-    int32[chunk, R]}`` stacked along the leading step axis.
+    int32[chunk, R]}`` stacked along the leading step axis (plus
+    ``ys["probe"]`` when probes are armed).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -103,11 +117,15 @@ def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
         )
     dt = float(dt)
     unroll = min(max(1, int(unroll)), chunk)
+    armed = probes is not None and probes.armed
 
     # gridlint: resident-path
     def macro(pos, vel, ids, count):
         def body(carry, _):
-            pos, vel, ids, count = carry
+            if armed:
+                pos, vel, ids, count, cum, live0 = carry
+            else:
+                pos, vel, ids, count = carry
             with traced_span("svc:drift"):
                 pos = nbody.service_drift(pos, vel, dt)
             with traced_span("svc:exchange"):
@@ -115,15 +133,28 @@ def make_chunk_fn(rd, dt, chunk, positions, *fields, unroll=8):
                     pos, count, vel, ids
                 )
             ys = {"stats": stats, "count": count}
-            return (pos, vel, ids, count), ys
+            if not armed:
+                return (pos, vel, ids, count), ys
+            with traced_span("svc:probe"):
+                cum = cum + statehealth.step_dropped(
+                    stats, pipelined=False
+                )
+                ys["probe"] = statehealth.summarize(
+                    pos, vel, count, live0, cum,
+                    probes.lo, probes.hi, probes.tier,
+                )
+            return (pos, vel, ids, count, cum, live0), ys
 
-        return lax.scan(
-            body,
-            (pos, vel, ids, count),
-            None,
-            length=chunk,
-            unroll=unroll,
+        init = (pos, vel, ids, count)
+        if armed:
+            init = init + (
+                jnp.int32(0),
+                jnp.sum(count).astype(jnp.int32),
+            )
+        carry, ys = lax.scan(
+            body, init, None, length=chunk, unroll=unroll
         )
+        return carry[:4], ys
 
     # progcheck J002 traces this program via the resident-marked
     # registry entry; the marker survives jit (on `.__wrapped__`) so the

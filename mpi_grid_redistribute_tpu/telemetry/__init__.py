@@ -106,6 +106,22 @@ queryable:
   is the live terminal dashboard and ``scripts/history.py`` the
   cross-run index.
 
+The state-health observatory (ISSUE 20) watches the *physics*, not
+just the system:
+
+* :mod:`.probes` — the host side of the in-graph invariant probes
+  (``ops/statehealth.py``): :class:`~.probes.ProbeConfig` (static
+  off/counters/moments tier; off is bit-identical zero-cost),
+  ``record_probe_steps`` journaling one ``state_health`` event per
+  scanned step (NaN/Inf rows, out-of-bounds positions, the exact int32
+  conservation residual, optional moments), and ``summarize_host``,
+  the counter-exact numpy mirror for the driver's eager path.
+* :mod:`.health` additionally grew the ``nan_detected`` /
+  ``conservation_drift`` / ``bounds_violation`` ALERT rules; the
+  driver's boundary gate turns their findings into a
+  ``StateCorruptionError`` restart BEFORE the snapshot hook, so the
+  supervisor restores a pre-corruption snapshot.
+
 Event schema and metric families: ``telemetry/SCHEMA.md``.
 """
 
@@ -157,11 +173,19 @@ from mpi_grid_redistribute_tpu.telemetry.health import (  # noqa: F401
     Finding,
     HealthMonitor,
     HealthRule,
+    bounds_violation,
     burn_rate_dropped,
     burn_rate_latency,
+    conservation_drift,
     default_rules,
     fast_path_fallback,
+    nan_detected,
     snapshot_staleness,
+)
+from mpi_grid_redistribute_tpu.telemetry.probes import (  # noqa: F401
+    ProbeConfig,
+    record_probe_steps,
+    summarize_host,
 )
 from mpi_grid_redistribute_tpu.telemetry.context import (  # noqa: F401
     StepContext,

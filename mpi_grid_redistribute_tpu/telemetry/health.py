@@ -38,6 +38,18 @@ The stock rules (:func:`default_rules`):
   exceeds ``factor`` x its recorded cadence: the service driver's
   checkpoint writer has stalled or died, so a crash now loses more work
   than the restart policy budgets for. WARN.
+* ``nan_detected`` — any retained ``state_health`` event with a
+  nonzero NaN/Inf row count (armed probes only, ISSUE 20); the reason
+  names the corrupting step. ALERT.
+* ``conservation_drift`` — any retained ``state_health`` event with a
+  nonzero exact conservation residual (rows appeared or vanished
+  unaccounted). ALERT.
+* ``bounds_violation`` — any retained ``state_health`` event with live
+  rows outside the probe's domain box. ALERT.
+
+This list IS the contract: SCHEMA.md's "Health rule table" mirrors it
+name-for-name in the same order with the same severities, and the drift
+test in ``tests/test_probes.py`` fails the suite when they disagree.
 
 Opt-in SLO rules (installed by the service driver when its SLO knobs
 are set; they actuate the restart/shrink policy, ISSUE 8):
@@ -262,6 +274,95 @@ def snapshot_staleness(factor: float = 2.0) -> HealthRule:
     return HealthRule("snapshot_staleness", WARN, fn)
 
 
+def _fresh_state_events(rec: StepRecorder):
+    """``state_health`` events journaled AFTER the newest supervised
+    state restore. A restore rolls the particle state back to a
+    pre-corruption snapshot, so corruption evidence older than it
+    describes state that no longer exists — without this cut a
+    recovered service would page on its own history until the ring
+    scrolled, and the supervisor's post-run ``healthz`` poll would turn
+    one rolled-back NaN burst into a permanent crash loop."""
+    ev = rec.events("state_health")
+    if not ev:
+        return ev
+    restores = [
+        e for e in rec.events("restore") if e.data.get("what") == "state"
+    ]
+    if not restores:
+        return ev
+    cut = restores[-1].seq
+    return [e for e in ev if e.seq > cut]
+
+
+def nan_detected() -> HealthRule:
+    """ALERT on the first fresh ``state_health`` event whose NaN/Inf
+    row count is nonzero (``nan_pos + nan_vel > 0``) — non-finite
+    particle state is corruption the moment it exists, never load. The
+    reason names the step, so the incident bundle's index pins exactly
+    where the corruption entered. Quiet when probes are off (no
+    ``state_health`` events is not evidence), and quiet about
+    corruption an intervening state restore already rolled back
+    (:func:`_fresh_state_events`)."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        for e in _fresh_state_events(rec):
+            n_pos = int(e.data.get("nan_pos", 0))
+            n_vel = int(e.data.get("nan_vel", 0))
+            if n_pos or n_vel:
+                return (
+                    f"non-finite state at step {e.data.get('step')}: "
+                    f"nan_pos={n_pos} nan_vel={n_vel} live rows corrupt"
+                )
+        return None
+
+    return HealthRule("nan_detected", ALERT, fn)
+
+
+def conservation_drift() -> HealthRule:
+    """ALERT on the first retained ``state_health`` event whose exact
+    int32 conservation residual (``live + dropped - initial``) is
+    nonzero — rows appeared or vanished without being accounted by the
+    exchange's own drop counters. Exact by construction: any nonzero
+    value fires, there is no threshold to tune. Like the other state
+    rules, only evidence newer than the latest state restore counts
+    (:func:`_fresh_state_events`)."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        for e in _fresh_state_events(rec):
+            r = int(e.data.get("residual", 0))
+            if r != 0:
+                return (
+                    f"conservation residual {r:+d} rows at step "
+                    f"{e.data.get('step')}: live + dropped != initial"
+                )
+        return None
+
+    return HealthRule("conservation_drift", ALERT, fn)
+
+
+def bounds_violation() -> HealthRule:
+    """ALERT on the first retained ``state_health`` event with live
+    rows outside the probe's domain box (``oob > 0``). The periodic
+    drift wraps every position into [0, 1), so an out-of-bounds row
+    means a broken integrator or wrap, not a fast particle. NaN rows
+    are counted by ``nan_detected`` only (IEEE comparisons are false
+    both ways), so the two rules partition the corrupt rows. Only
+    evidence newer than the latest state restore counts
+    (:func:`_fresh_state_events`)."""
+
+    def fn(rec: StepRecorder) -> Optional[str]:
+        for e in _fresh_state_events(rec):
+            oob = int(e.data.get("oob", 0))
+            if oob:
+                return (
+                    f"{oob} live rows out of the domain box at step "
+                    f"{e.data.get('step')}"
+                )
+        return None
+
+    return HealthRule("bounds_violation", ALERT, fn)
+
+
 def slo_latency_p99(
     threshold_s: float, window: int = 16, q: float = 0.99
 ) -> HealthRule:
@@ -471,6 +572,11 @@ def burn_rate_dropped(
 
 
 def default_rules() -> List[HealthRule]:
+    """The stock rule set, in evaluation order. SCHEMA.md's "Health
+    rule table" is the documentation twin of this list — name, order
+    and severity are asserted equal by the drift test in
+    ``tests/test_probes.py``, so a rule added here must land there in
+    the same breath (and vice versa)."""
     return [
         backlog_growth(),
         dropped_rows(),
@@ -479,6 +585,9 @@ def default_rules() -> List[HealthRule]:
         step_time_spike(),
         fast_path_fallback(),
         snapshot_staleness(),
+        nan_detected(),
+        conservation_drift(),
+        bounds_violation(),
     ]
 
 

@@ -452,7 +452,12 @@ def from_journal(
     * ``roofline_achieved_fraction{program,phase}`` — latest analytic
       predicted/measured fraction per ``roofline`` event;
     * ``profile_sessions_total`` — ``profile_session`` events (profiler
-      captures attempted).
+      captures attempted);
+    * ``state_nan_total{field}`` / ``state_oob_total`` — corrupt-row
+      totals over the journaled ``state_health`` window (ISSUE 20);
+    * ``state_live_rows`` / ``state_residual`` — latest conservation-
+      ledger gauges (a nonzero residual is row loss/creation the
+      exchange never accounted).
     """
     reg = registry if registry is not None else MetricsRegistry()
     events, counts = _iter_events(source)
@@ -562,8 +567,29 @@ def from_journal(
         "Profiler trace sessions attempted (profile_session events;"
         " armed or degraded alike)",
     )
+    state_nan = reg.counter(
+        f"{p}_state_nan",
+        "Live rows with non-finite components over the journaled"
+        " state_health window, per field (any nonzero is corruption)",
+        ("field",),
+    )
+    state_oob = reg.counter(
+        f"{p}_state_oob",
+        "Live rows with positions outside the probe's domain box over"
+        " the journaled state_health window",
+    )
+    state_live = reg.gauge(
+        f"{p}_state_live_rows",
+        "Total live particle rows at the latest probed step"
+        " (state_health events)",
+    )
+    state_res = reg.gauge(
+        f"{p}_state_residual",
+        "Exact conservation residual (live + dropped - initial) at the"
+        " latest probed step; nonzero = unaccounted row loss/creation",
+    )
 
-    saw_migrate = saw_flow = saw_roofline = False
+    saw_migrate = saw_flow = saw_roofline = saw_state = False
     for kind, data in events:
         if kind == "migrate_step":
             saw_migrate = True
@@ -625,6 +651,28 @@ def from_journal(
                 ).set(float(data["achieved_fraction"]))
         elif kind == "profile_session":
             profile_c.labels().inc()
+        elif kind == "state_health":
+            saw_state = True
+            state_nan.labels(field="pos").inc(int(data.get("nan_pos", 0)))
+            state_nan.labels(field="vel").inc(int(data.get("nan_vel", 0)))
+            state_oob.labels().inc(int(data.get("oob", 0)))
+            if "live" in data:
+                state_live.labels().set(int(data["live"]))
+            if "residual" in data:
+                state_res.labels().set(int(data["residual"]))
+        elif kind == "store_window":
+            # compacted state_health windows keep feeding the corrupt-
+            # row totals after the raw per-step rows are gone
+            st = data.get("state")
+            if st:
+                saw_state = True
+                state_nan.labels(field="pos").inc(int(st.get("nan_pos", 0)))
+                state_nan.labels(field="vel").inc(int(st.get("nan_vel", 0)))
+                state_oob.labels().inc(int(st.get("oob", 0)))
+                if st.get("live_last") is not None:
+                    state_live.labels().set(int(st["live_last"]))
+                if st.get("residual_last") is not None:
+                    state_res.labels().set(int(st["residual_last"]))
     # gauges with no samples yet would render a misleading 0 — only
     # materialize the step-scoped gauges once their kind has appeared
     if not saw_migrate:
@@ -635,4 +683,7 @@ def from_journal(
             fam._children.clear()
     if not saw_roofline:
         roofline_g._children.clear()
+    if not saw_state:
+        for fam in (state_live, state_res):
+            fam._children.clear()
     return reg

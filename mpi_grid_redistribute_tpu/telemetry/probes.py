@@ -1,0 +1,162 @@
+"""Host side of the state-health observatory (ISSUE 20).
+
+The in-graph half lives in ``ops/statehealth.py``: the resident and
+pipelined macro-steps fold a per-step state summary (live rows, NaN/Inf
+counts, out-of-bounds positions, the conservation residual, optional
+moments) into their scan ys. This module is everything the *host* does
+with those summaries:
+
+* :class:`ProbeConfig` — the static tier knob (``off`` / ``counters``
+  / ``moments``). Frozen and hashable so it joins the driver's
+  compiled-macro cache key: changing the tier is a retrace, never a
+  silent reuse of the wrong program. ``off`` is the default and is
+  bit-identical zero-cost — the builders emit the exact unprobed
+  program (``tests/test_probes.py`` pins jaxpr equality).
+* :func:`record_probe_steps` — the chunk-boundary bridge (the
+  ``record_chunk_steps`` pattern): one ``state_health`` journal event
+  per scanned step, from already-fetched host arrays.
+* :func:`summarize_host` — the numpy mirror of the in-graph summary,
+  bit-compatible in every counter, for the driver's eager path (numpy
+  backend, singleton fault chunks, overflow re-runs) so probed runs
+  journal the same event stream whatever path executed the step.
+
+Scrape-path purity: jax-free (G007) — ``tests/test_metrics.py`` loads
+this module with jax absent. Event schema: telemetry/SCHEMA.md
+``state_health``; the ``nan_detected`` / ``conservation_drift`` /
+``bounds_violation`` health rules (telemetry/health.py) evaluate over
+these events.
+"""
+
+from __future__ import annotations
+
+# gridlint: scrape-path
+
+import dataclasses
+
+import numpy as np
+
+#: Probe tiers, cheapest first. ``off`` emits nothing (bit-identical
+#: program); ``counters`` adds five int32 scalars per step;
+#: ``moments`` adds per-axis position extents and the velocity second
+#: moment on top.
+TIERS = ("off", "counters", "moments")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbeConfig:
+    """Static probe configuration (hashable: cache-key safe).
+
+    ``tier`` selects what the in-graph pass computes; bounds give the
+    domain box the ``oob`` counter checks positions against (the
+    service domain is the periodic unit box, so ``[0, 1)``)."""
+
+    tier: str = "off"
+    lo: float = 0.0
+    hi: float = 1.0
+
+    def __post_init__(self):
+        if self.tier not in TIERS:
+            raise ValueError(
+                f"unknown probe tier {self.tier!r} (choose from {TIERS})"
+            )
+        if not self.hi > self.lo:
+            raise ValueError(
+                f"probe bounds must satisfy lo < hi, got "
+                f"[{self.lo}, {self.hi})"
+            )
+
+    @property
+    def armed(self) -> bool:
+        return self.tier != "off"
+
+    @property
+    def moments(self) -> bool:
+        return self.tier == "moments"
+
+
+def record_probe_steps(recorder, first_step: int, probe) -> int:
+    """Feed one chunk's stacked probe ys into ``recorder`` as one
+    ``state_health`` event per step.
+
+    ``probe`` is the ``ys["probe"]`` dict from a probe-armed macro-step
+    — leaves stacked ``[chunk]`` (scalars) or ``[chunk, ndim]``
+    (moment vectors). Same host-transfer contract as
+    :func:`.recorder.record_chunk_steps`: the caller passes
+    already-fetched host values at a chunk boundary, never device
+    arrays from a hot loop. Steps are numbered ``first_step,
+    first_step + 1, ...`` — the post-increment numbering every other
+    per-step event kind uses. Returns the number of events recorded."""
+    live = np.asarray(probe["live"])
+    nan_pos = np.asarray(probe["nan_pos"])
+    nan_vel = np.asarray(probe["nan_vel"])
+    oob = np.asarray(probe["oob"])
+    residual = np.asarray(probe["residual"])
+    pos_min = probe.get("pos_min")
+    pos_max = probe.get("pos_max")
+    vel_m2 = probe.get("vel_m2")
+    n = int(live.shape[0])
+    for i in range(n):
+        extra = {}
+        if pos_min is not None:
+            extra["pos_min"] = [float(x) for x in np.asarray(pos_min)[i]]
+            extra["pos_max"] = [float(x) for x in np.asarray(pos_max)[i]]
+            extra["vel_m2"] = float(np.asarray(vel_m2)[i])
+        recorder.record(
+            "state_health",
+            step=int(first_step) + i,
+            live=int(live[i]),
+            nan_pos=int(nan_pos[i]),
+            nan_vel=int(nan_vel[i]),
+            oob=int(oob[i]),
+            residual=int(residual[i]),
+            **extra,
+        )
+    return n
+
+
+def summarize_host(
+    pos, vel, count, initial_live, cum_dropped, cfg: ProbeConfig
+):
+    """Numpy mirror of ``ops.statehealth.summarize`` for the eager
+    driver path: one ``state_health`` payload dict (host scalars,
+    ready for ``recorder.record``) from prefix-valid ``[R * cap,
+    ndim]`` state. Counter-exact against the in-graph pass — a step
+    executed eagerly (fault chunk, overflow re-run, numpy backend)
+    journals the same numbers the resident scan would have."""
+    pos = np.asarray(pos)
+    vel = np.asarray(vel)
+    count = np.asarray(count)
+    cap = pos.shape[0] // count.shape[0]
+    mask = (
+        np.arange(cap, dtype=np.int32)[None, :] < count[:, None]
+    ).reshape(-1)
+    with np.errstate(invalid="ignore"):
+        bad_pos = ~np.isfinite(pos)
+        bad_vel = ~np.isfinite(vel)
+        out = (pos < cfg.lo) | (pos >= cfg.hi)
+    live = int(count.sum())
+    payload = {
+        "live": live,
+        "nan_pos": int(np.sum(np.any(bad_pos, axis=-1) & mask)),
+        "nan_vel": int(np.sum(np.any(bad_vel, axis=-1) & mask)),
+        "oob": int(np.sum(np.any(out, axis=-1) & mask)),
+        "residual": live + int(cum_dropped) - int(initial_live),
+    }
+    if cfg.moments:
+        m = mask[:, None]
+        posf = pos.astype(np.float32)
+        velf = vel.astype(np.float32)
+        payload["pos_min"] = [
+            float(x)
+            for x in np.min(np.where(m, posf, np.float32(np.inf)), axis=0)
+        ]
+        payload["pos_max"] = [
+            float(x)
+            for x in np.max(
+                np.where(m, posf, np.float32(-np.inf)), axis=0
+            )
+        ]
+        payload["vel_m2"] = float(
+            np.sum(np.where(m, velf * velf, np.float32(0.0)))
+        )
+    return payload

@@ -113,6 +113,15 @@ GUARDED_METRICS: Dict[str, str] = {
     # state, or the fused free-stack landing split into two scatters.
     # Auto-arms: skipped against histories that predate the field.
     "pipeline_pps": "higher",
+    # the state-health probe pass's cost ratio (bench.py "service" key
+    # <- config10_service, ISSUE 20): probed/unprobed step time at the
+    # head chunk, 1.0 = free. Guarded LOWER as the ratio (the raw
+    # paired-delta median is centred on zero, where relative-change
+    # math is meaningless) — the hard <= 2% budget is config10's own
+    # gate; this guard catches a probe pass that quietly grows past its
+    # history. Auto-arms: skipped against histories that predate the
+    # field.
+    "probe_cost_factor": "lower",
 }
 
 # nested fallbacks: a metric missing at the top level of the parsed
@@ -130,6 +139,7 @@ _NESTED_KEYS: Dict[str, Tuple[str, str]] = {
     "rebalance_drift_ms": ("rebalance", "steady_ms_per_step"),
     "service_pps": ("service", "value"),
     "pipeline_pps": ("service", "pipeline_pps"),
+    "probe_cost_factor": ("service", "probe_cost_factor"),
 }
 
 

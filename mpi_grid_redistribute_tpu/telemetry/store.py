@@ -26,15 +26,15 @@ of on-disk **segments** with a checksummed manifest:
 * **Compaction** — closed raw segments are downsampled into summary
   segments: the per-step flood (``step_latency`` / ``step_time`` /
   ``migrate_step`` / ``fast_path`` / ``redistribute`` /
-  ``flow_snapshot``) collapses into one ``store_window`` row per
-  window carrying *exact* per-kind counts, step-latency/step-time
-  histogram sketches on the metrics plane's own pow2 edges
-  (``metrics.STEP_TIME_EDGES`` — so a quantile computed from a
-  compacted store equals the one ``/metrics`` serves), dropped/mover
-  totals and flow-imbalance samples, while every non-step event
-  (alerts, incidents, snapshots, restores, faults, …) is preserved
-  **verbatim**. A million-step run keeps bounded disk and exact
-  all-time counts.
+  ``flow_snapshot`` / ``state_health``) collapses into one
+  ``store_window`` row per window carrying *exact* per-kind counts,
+  step-latency/step-time histogram sketches on the metrics plane's own
+  pow2 edges (``metrics.STEP_TIME_EDGES`` — so a quantile computed
+  from a compacted store equals the one ``/metrics`` serves),
+  dropped/mover totals, flow-imbalance samples and state-health
+  corrupt-row totals, while every non-step event (alerts, incidents,
+  snapshots, restores, faults, …) is preserved **verbatim**. A
+  million-step run keeps bounded disk and exact all-time counts.
 
 Every drain journals a ``store_drain`` event into the recorder it
 drains — recorded *before* the snapshot is taken, so the drained
@@ -80,6 +80,7 @@ COMPACT_KINDS = frozenset(
         "fast_path",
         "redistribute",
         "flow_snapshot",
+        "state_health",
     )
 )
 
@@ -484,6 +485,10 @@ class JournalStore:
         migrate = {"sent": 0, "received": 0, "dropped_recv": 0}
         backlog_last = None
         population_last = None
+        state = {"nan_pos": 0, "nan_vel": 0, "oob": 0}
+        state_live_last = None
+        state_residual_last = None
+        saw_state = False
         step_min = None
         step_max = None
         imbalance: List[List[float]] = []
@@ -519,6 +524,14 @@ class JournalStore:
                     imbalance.append(
                         [float(r.get("time", 0.0)), float(r["imbalance"])]
                     )
+            elif kind == "state_health":
+                saw_state = True
+                for key in state:
+                    state[key] += int(r.get(key, 0))
+                if "live" in r:
+                    state_live_last = int(r["live"])
+                if "residual" in r:
+                    state_residual_last = int(r["residual"])
         if len(imbalance) > _IMBALANCE_SAMPLES:
             # keep first/last and the extremes: enough to redraw the
             # per-window imbalance envelope without the full series
@@ -555,6 +568,15 @@ class JournalStore:
             ),
             "imbalance": imbalance,
         }
+        if saw_state:
+            # corrupt-row totals are exact across compaction; the
+            # latest ledger gauges ride along so grid_state_live_rows /
+            # grid_state_residual survive the raw rows' deletion
+            doc["state"] = dict(
+                state,
+                live_last=state_live_last,
+                residual_last=state_residual_last,
+            )
         if step_min is not None:
             doc["step_min"] = step_min
             doc["step_max"] = step_max

@@ -14,8 +14,11 @@ telemetry plane, refreshed in place. Two sources:
   server has them, ``/query``-backed panels.
 
 Panels: step rate + p50/p99 step latency, fast-path hit rate, engine
-mix, flow imbalance, population/backlog, active health findings,
-recent alerts and incidents.
+mix, flow imbalance, population/backlog, state health (live rows,
+NaN/out-of-bounds totals, conservation residual — shown only when the
+run journaled ``state_health`` probe events; any nonzero corruption
+counter flags ``** CORRUPT **``), active health findings, recent alerts
+and incidents.
 
 ``--once`` prints a single plain-text snapshot and exits — the CI mode
 (no ANSI, no loop); exit code 0 when the source was readable. Stdlib
@@ -76,6 +79,7 @@ def collect_store(store_dir: str) -> dict:
     fp_taken = fp_total = 0
     imbalance = None
     dropped = 0
+    state = None  # stays None until a probe event proves probes were on
     for r in rows:
         kind = r.get("kind")
         if kind == "fast_path":
@@ -88,11 +92,30 @@ def collect_store(store_dir: str) -> dict:
             dropped += int(r.get("dropped", {}).get("total", 0))
             for _, v in r.get("imbalance", []):
                 imbalance = v
+            st = r.get("state")
+            if st:
+                state = state or {"nan": 0, "oob": 0,
+                                  "live": None, "residual": None}
+                state["nan"] += int(st.get("nan_pos", 0))
+                state["nan"] += int(st.get("nan_vel", 0))
+                state["oob"] += int(st.get("oob", 0))
+                if st.get("live_last") is not None:
+                    state["live"] = int(st["live_last"])
+                if st.get("residual_last") is not None:
+                    state["residual"] = int(st["residual_last"])
         elif kind == "flow_snapshot":
             if "imbalance" in r:
                 imbalance = float(r["imbalance"])
         elif kind == "step_latency":
             dropped += int(r.get("dropped", 0))
+        elif kind == "state_health":
+            state = state or {"nan": 0, "oob": 0,
+                              "live": None, "residual": None}
+            state["nan"] += int(r.get("nan_pos", 0))
+            state["nan"] += int(r.get("nan_vel", 0))
+            state["oob"] += int(r.get("oob", 0))
+            state["live"] = int(r.get("live", 0))
+            state["residual"] = int(r.get("residual", 0))
 
     engines: dict = {}
     for r in query_lib.filter_rows(rows, kind="redistribute"):
@@ -147,6 +170,7 @@ def collect_store(store_dir: str) -> dict:
         "dropped": dropped,
         "population": pop,
         "backlog": backlog,
+        "state": state,
         "health": None,
         "alerts": alerts[-5:],
         "incidents": incidents[-5:],
@@ -237,6 +261,19 @@ def collect_url(base: str) -> dict:
             if part.startswith('engine="'):
                 engines[part[8:-1]] = int(v)
 
+    state = None
+    nan_fam = fam.get("grid_state_nan_total", {})
+    oob_fam = fam.get("grid_state_oob_total", {})
+    live_g = fam.get("grid_state_live_rows", {}).get("")
+    res_g = fam.get("grid_state_residual", {}).get("")
+    if nan_fam or oob_fam or live_g is not None:
+        state = {
+            "nan": int(sum(nan_fam.values())),
+            "oob": int(sum(oob_fam.values())),
+            "live": None if live_g is None else int(live_g),
+            "residual": None if res_g is None else int(res_g),
+        }
+
     health = None
     try:
         health = json.loads(_fetch(f"{base}/healthz"))
@@ -288,6 +325,7 @@ def collect_url(base: str) -> dict:
         "dropped": None,
         "population": fam.get("grid_population_rows", {}).get(""),
         "backlog": fam.get("grid_backlog_rows", {}).get(""),
+        "state": state,
         "health": health,
         "alerts": alerts[-5:],
         "incidents": incidents[-5:],
@@ -343,6 +381,17 @@ def render(d: dict, width: int = 72) -> str:
         + f"backlog {_fmt(d['backlog'])}".ljust(16)
         + f"dropped {_fmt(d['dropped'])}",
     ]
+    state = d.get("state")
+    if state is not None:
+        clean = not state["nan"] and not state["oob"] and not state["residual"]
+        lines.append(
+            "  state".ljust(14)
+            + f"live {_fmt(state['live'], digits=6)}".ljust(18)
+            + f"nan {state['nan']}".ljust(12)
+            + f"oob {state['oob']}".ljust(12)
+            + f"residual {_fmt(state['residual'])}"
+            + ("" if clean else "  ** CORRUPT **")
+        )
     if d.get("segments") is not None:
         lines.append(
             "  store".ljust(14)

@@ -53,11 +53,12 @@ RULE_DOCS = {
     "ST05": "retention bound: closed segments must fit the configured "
     "retain_bytes budget after every publish",
     "ST06": "compaction exactness: a summary segment's per-kind counts, "
-    "window sketches and verbatim non-step rows must reproduce its "
-    "raw source exactly",
+    "window sketches, state-health corruption ledgers and verbatim "
+    "non-step rows must reproduce its raw source exactly",
     "ST07": "end-to-end exactness: metrics.from_journal over the "
     "drained+compacted store must equal the live recorder's all-time "
-    "counts after ring eviction",
+    "counts after ring eviction, and its grid_state_* corruption "
+    "totals must equal a direct walk of the retained segment files",
 }
 
 _SELF = "scripts/storecheck.py"
@@ -214,7 +215,45 @@ def _check_compaction(reader, root):
                 f"{seg['name']} kept per-step kind(s) {bad_kind} "
                 f"verbatim (should be windowed)",
             ))
+        # a window that swallowed state_health rows must carry the
+        # corruption ledger, or compaction silently forgot corruption
+        for w in windows:
+            n_state = int(w.get("counts", {}).get("state_health", 0))
+            if n_state and "state" not in w:
+                findings.append(_finding(
+                    "ST06",
+                    f"{seg['name']} window at seq {w.get('seq')} holds "
+                    f"{n_state} state_health rows but no state ledger",
+                ))
     return findings
+
+
+def _state_totals_from_disk(reader, root):
+    """Corrupt-row totals re-derived by walking every retained segment
+    file directly: raw ``state_health`` rows plus the ``state`` ledgers
+    of compacted windows. The independent ground truth ST07 holds
+    ``metrics.from_journal`` (which folds the same two row shapes
+    through a different code path) to."""
+    totals = {"nan_pos": 0, "nan_vel": 0, "oob": 0}
+    man = reader.manifest
+    segs = list(man["segments"])
+    if man.get("active"):
+        segs.append(man["active"])
+    for seg in segs:
+        with open(os.path.join(root, seg["name"]), encoding="utf-8") as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                row = json.loads(ln)
+                if row.get("kind") == "state_health":
+                    for k in totals:
+                        totals[k] += int(row.get(k, 0))
+                elif row.get("kind") == "store_window":
+                    st = row.get("state")
+                    if st:
+                        for k in totals:
+                            totals[k] += int(st.get(k, 0))
+    return totals
 
 
 def check_store(root, batch_bound=None):
@@ -269,9 +308,22 @@ def run_demo(out_dir, verbose=True):
     # 20 chunks x 45 step_latency events + a sprinkling of non-step
     # events: the 96-slot ring wraps ~9x, rotation closes ~8 segments,
     # compaction summarises all but the newest, retention retires the
-    # oldest — every lifecycle path runs
+    # oldest — every lifecycle path runs. Each chunk also journals a
+    # few probed-run state_health rows (ISSUE 20) with two NaN/OOB
+    # bursts late enough to survive retention, so the compacted
+    # windows' corruption ledgers are exercised non-vacuously
     for chunk in range(20):
         record_chunk_steps(rec, chunk * 45, 0.002, [0] * 45)
+        for i in range(3):
+            rec.record(
+                "state_health",
+                step=chunk * 45 + 15 * i,
+                live=360,
+                nan_pos=4 if (chunk, i) == (16, 1) else 0,
+                nan_vel=0,
+                oob=2 if (chunk, i) == (18, 2) else 0,
+                residual=0,
+            )
         if chunk % 4 == 0:
             rec.record(
                 "alert", rule="demo_rule", severity="warn",
@@ -322,6 +374,28 @@ def run_demo(out_dir, verbose=True):
             f"scraped {scraped} vs live {live}",
         ))
 
+    # ST07 state leg: the scrape's corruption totals (raw state_health
+    # rows for the newest segments, compacted `state` ledgers for the
+    # rest) must equal a direct walk of the retained segment files
+    disk = _state_totals_from_disk(reader, root)
+    if not (disk["nan_pos"] and disk["oob"]):
+        findings.append(_finding(
+            "ST06",
+            f"demo corruption bursts did not survive to a retained "
+            f"segment ({disk}); state-ledger exactness is vacuous",
+        ))
+    state_scraped = {"nan_pos": 0, "nan_vel": 0, "oob": 0}
+    for values, child in reg.get("grid_state_nan").children():
+        state_scraped[f"nan_{values[0]}"] = int(child._value)
+    for values, child in reg.get("grid_state_oob").children():
+        state_scraped["oob"] = int(child._value)
+    if state_scraped != disk:
+        findings.append(_finding(
+            "ST07",
+            f"grid_state_* corruption totals diverge from the segment "
+            f"files: scraped {state_scraped} vs disk {disk}",
+        ))
+
     if verbose:
         kinds = ", ".join(f"{k}={v}" for k, v in sorted(live.items()))
         print(
@@ -338,6 +412,11 @@ def run_demo(out_dir, verbose=True):
         print(
             f"demo: merged latency histogram n={h.count} "
             f"p99={h.quantile(0.99):.6g}s"
+        )
+        print(
+            f"demo: state corruption totals from disk "
+            f"nan_pos={disk['nan_pos']} nan_vel={disk['nan_vel']} "
+            f"oob={disk['oob']} (raw rows + compacted ledgers)"
         )
     return findings, reader
 

@@ -1,0 +1,490 @@
+"""State-health observatory (ISSUE 20): in-graph invariant probes.
+
+Four contracts pinned here:
+
+* **Hand-math semantics** — the in-graph summary
+  (``ops/statehealth.py``) and its numpy mirror
+  (``telemetry.probes.summarize_host``) agree bit-for-bit on every
+  counter against fixtures with a known corruption layout: NaN rows
+  count in ``nan_pos`` only (IEEE comparisons are false both ways),
+  ±Inf position rows count in BOTH ``nan_pos`` and ``oob``, dead
+  (padding) rows never count whatever garbage they hold, and the
+  conservation residual is exact int32 arithmetic.
+* **Off tier is bit-identical zero-cost** — ``make_chunk_fn`` with
+  ``probes=ProbeConfig("off")`` emits the EXACT unprobed program
+  (jaxpr equality for chunk in {1, 7, 16}), and a counters-probed
+  driver run reproduces the unprobed run's particle set and count
+  bytes — observing the state never perturbs it.
+* **Probes stay in-graph** — a jaxpr walk over the armed macro-step
+  (both tiers) finds the ``lax.scan`` and no callback/infeed/outfeed
+  primitive: the summary rides the scan ys, it never syncs to the
+  host mid-chunk (the dynamic backstop behind progcheck J002 for the
+  probe-armed registry program).
+* **End-to-end recovery** — an injected :class:`StateCorruptionFault`
+  produces a nonzero ``nan_pos`` ``state_health`` event, the
+  ``nan_detected`` rule ALERTs naming the step, the boundary gate
+  restarts the driver BEFORE the corruption is snapshotted, and the
+  supervised run finishes bit-identical to an unfaulted reference.
+
+Plus the documentation drift test SCHEMA.md and ``health.py`` both
+name: ``test_default_rules_match_schema_table`` asserts the "Health
+rule table" and ``default_rules()`` agree on name, order and severity.
+"""
+
+import dataclasses
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import mpi_grid_redistribute_tpu.telemetry.health as health
+from mpi_grid_redistribute_tpu.service import (
+    DriverConfig,
+    FaultPlan,
+    RestartPolicy,
+    ServiceDriver,
+    StateCorruptionFault,
+    Supervisor,
+)
+from mpi_grid_redistribute_tpu.service import elastic, resident
+from mpi_grid_redistribute_tpu.telemetry import StepRecorder
+from mpi_grid_redistribute_tpu.telemetry.probes import (
+    ProbeConfig,
+    record_probe_steps,
+    summarize_host,
+)
+
+CHUNKS = (1, 7, 16)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        grid_shape=(2, 2, 2),
+        n_local=256,
+        steps=24,
+        seed=3,
+        backend="numpy",
+        snapshot_every=4,
+        snapshot_dir=str(tmp_path / "snaps"),
+    )
+    base.update(kw)
+    return DriverConfig(**base)
+
+
+def _jax_cfg(tmp_path, **kw):
+    base = dict(
+        grid_shape=(2, 2, 2),
+        n_local=256,
+        steps=12,
+        seed=5,
+        backend="jax",
+        snapshot_every=0,
+        snapshot_dir=None,
+        watchdog_s=0.0,
+    )
+    base.update(kw)
+    return DriverConfig(**base)
+
+
+def _supervised(cfg, faults, max_restarts=5):
+    rec = StepRecorder()
+
+    def factory(grid_shape=None):
+        c = cfg
+        if grid_shape is not None:
+            c = dataclasses.replace(c, grid_shape=tuple(grid_shape))
+        return ServiceDriver(c, recorder=rec, faults=faults)
+
+    sup = Supervisor(
+        factory,
+        policy=RestartPolicy(
+            max_restarts=max_restarts, backoff_base_s=0.01,
+            backoff_cap_s=0.02,
+        ),
+        recorder=rec,
+        sleep_fn=lambda s: None,
+    )
+    return sup, rec
+
+
+def _reference_state(cfg):
+    """The uninterrupted trajectory: same config, snapshots/journal off
+    (neither may influence the state for restarts to be bit-exact)."""
+    ref = ServiceDriver(
+        dataclasses.replace(
+            cfg, snapshot_every=0, snapshot_dir=None, journal_dir=None,
+            watchdog_s=0.0,
+        )
+    )
+    ref.init_state()
+    state = ref.run()
+    ref.close()
+    return state
+
+
+def _assert_bit_identical(a, b):
+    for name, x, y in zip(("pos", "vel", "ids", "count"), a, b):
+        assert x.tobytes() == y.tobytes(), f"{name} diverged"
+
+
+# ------------------------------------------------- hand-math fixtures
+
+
+def _corrupt_fixture():
+    """2 shards x cap 4, ndim 3, count [3, 2]: one clean row, one NaN
+    position (nan_pos only), one +Inf position (nan_pos AND oob), one
+    finite out-of-bounds row, one NaN velocity — and three dead rows
+    stuffed with the worst garbage available."""
+    pos = np.array(
+        [
+            [0.1, 0.2, 0.3],        # live, clean
+            [np.nan, 0.5, 0.5],     # live: nan_pos, NOT oob
+            [np.inf, 0.5, 0.5],     # live: nan_pos AND oob
+            [np.nan, np.inf, -5.0], # dead garbage — must not count
+            [1.5, 0.5, 0.5],        # live: oob only
+            [0.9, 0.0, 0.25],       # live, clean pos (vel is NaN)
+            [2.5, np.nan, 0.5],     # dead garbage
+            [0.5, 0.5, 0.5],        # dead (clean-looking) garbage
+        ],
+        dtype=np.float32,
+    )
+    vel = np.tile(
+        np.array([0.5, -0.25, 1.0], dtype=np.float32), (8, 1)
+    )
+    vel[3] = [np.inf, 0.0, 0.0]     # dead
+    vel[5] = [np.nan, 0.0, 0.0]     # live: nan_vel
+    vel[6] = np.nan                 # dead
+    count = np.array([3, 2], dtype=np.int32)
+    expect = {
+        "live": 5, "nan_pos": 2, "nan_vel": 1, "oob": 2, "residual": 0,
+    }
+    return pos, vel, count, expect
+
+
+def _clean_fixture():
+    """2 shards x cap 2, ndim 2, count [2, 1], dyadic values — the
+    moments are exact in float32, so even pos_min/pos_max/vel_m2 admit
+    equality assertions."""
+    pos = np.array(
+        [[0.25, 0.5], [0.75, 0.125], [0.5, 0.875], [9.0, -9.0]],
+        dtype=np.float32,
+    )
+    vel = np.array(
+        [[1.0, 2.0], [-2.0, 0.0], [0.5, 0.5], [100.0, 100.0]],
+        dtype=np.float32,
+    )
+    count = np.array([2, 1], dtype=np.int32)
+    expect = {
+        "live": 3, "nan_pos": 0, "nan_vel": 0, "oob": 0, "residual": 0,
+        "pos_min": [0.25, 0.125], "pos_max": [0.75, 0.875],
+        "vel_m2": 9.5,
+    }
+    return pos, vel, count, expect
+
+
+def _summarize_graph(pos, vel, count, initial, dropped, tier):
+    import jax.numpy as jnp
+
+    from mpi_grid_redistribute_tpu.ops import statehealth
+
+    out = statehealth.summarize(
+        jnp.asarray(pos), jnp.asarray(vel), jnp.asarray(count),
+        jnp.int32(initial), jnp.int32(dropped), 0.0, 1.0, tier,
+    )
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+COUNTERS = ("live", "nan_pos", "nan_vel", "oob", "residual")
+
+
+def test_counters_hand_math_corrupt_fixture():
+    pos, vel, count, expect = _corrupt_fixture()
+    # initial 8, 3 rows legitimately dropped since -> live 5, residual 0
+    got = _summarize_graph(pos, vel, count, 8, 3, "counters")
+    for k in COUNTERS:
+        assert int(got[k]) == expect[k], k
+    host = summarize_host(pos, vel, count, 8, 3, ProbeConfig("counters"))
+    assert {k: int(v) for k, v in host.items()} == expect
+
+
+def test_residual_is_exact_and_signed():
+    pos, vel, count, _ = _corrupt_fixture()
+    # 5 live + 2 dropped - 8 initial = -1: a row vanished unaccounted
+    for fn in (
+        lambda: _summarize_graph(pos, vel, count, 8, 2, "counters"),
+        lambda: summarize_host(
+            pos, vel, count, 8, 2, ProbeConfig("counters")
+        ),
+    ):
+        assert int(fn()["residual"]) == -1
+    # 5 live + 4 dropped - 8 initial = +1: a row appeared from nowhere
+    assert int(
+        _summarize_graph(pos, vel, count, 8, 4, "counters")["residual"]
+    ) == 1
+
+
+def test_moments_hand_math_clean_fixture():
+    pos, vel, count, expect = _clean_fixture()
+    for payload in (
+        _summarize_graph(pos, vel, count, 3, 0, "moments"),
+        summarize_host(pos, vel, count, 3, 0, ProbeConfig("moments")),
+    ):
+        for k in COUNTERS:
+            assert int(payload[k]) == expect[k], k
+        assert [float(x) for x in payload["pos_min"]] == expect["pos_min"]
+        assert [float(x) for x in payload["pos_max"]] == expect["pos_max"]
+        assert float(payload["vel_m2"]) == expect["vel_m2"]
+
+
+def test_graph_matches_host_mirror_fuzz():
+    """Seeded fuzz: random prefix-valid layouts with NaN/Inf/OOB salted
+    into live AND dead rows. Counters must match the numpy mirror
+    exactly; moments only float-close (f32 reduction order differs)."""
+    rng = np.random.default_rng(20)
+    for trial in range(12):
+        nranks, cap, ndim = 4, 16, 3
+        n = nranks * cap
+        pos = rng.uniform(0.0, 1.0, (n, ndim)).astype(np.float32)
+        vel = rng.normal(0.0, 1.0, (n, ndim)).astype(np.float32)
+        for arr, vals in (
+            (pos, (np.nan, np.inf, -np.inf, 1.5, -0.5)),
+            (vel, (np.nan, np.inf, -np.inf)),
+        ):
+            k = rng.integers(0, 12)
+            rows = rng.integers(0, n, k)
+            cols = rng.integers(0, ndim, k)
+            arr[rows, cols] = rng.choice(vals, k)
+        count = rng.integers(0, cap + 1, nranks).astype(np.int32)
+        initial = int(count.sum()) + int(rng.integers(-3, 4))
+        dropped = int(rng.integers(0, 5))
+        tier = ("counters", "moments")[trial % 2]
+        graph = _summarize_graph(pos, vel, count, initial, dropped, tier)
+        host = summarize_host(
+            pos, vel, count, initial, dropped, ProbeConfig(tier)
+        )
+        for k in COUNTERS:
+            assert int(graph[k]) == int(host[k]), (trial, k)
+        if tier == "moments":
+            for k in ("pos_min", "pos_max", "vel_m2"):
+                np.testing.assert_allclose(
+                    np.asarray(graph[k], dtype=np.float64),
+                    np.asarray(host[k], dtype=np.float64),
+                    rtol=1e-5, equal_nan=True, err_msg=f"{trial}:{k}",
+                )
+
+
+def test_probe_config_validation():
+    assert ProbeConfig().tier == "off"
+    assert not ProbeConfig().armed
+    assert ProbeConfig("counters").armed
+    assert not ProbeConfig("counters").moments
+    assert ProbeConfig("moments").moments
+    with pytest.raises(ValueError, match="unknown probe tier"):
+        ProbeConfig("verbose")
+    with pytest.raises(ValueError, match="lo < hi"):
+        ProbeConfig("counters", lo=1.0, hi=1.0)
+
+
+def test_record_probe_steps_event_stream():
+    rec = StepRecorder()
+    probe = {
+        "live": np.array([10, 9, 9]),
+        "nan_pos": np.array([0, 2, 0]),
+        "nan_vel": np.array([0, 0, 1]),
+        "oob": np.array([0, 0, 3]),
+        "residual": np.array([0, -1, 0]),
+    }
+    assert record_probe_steps(rec, 5, probe) == 3
+    ev = rec.events("state_health")
+    assert [e.data["step"] for e in ev] == [5, 6, 7]
+    assert [e.data["nan_pos"] for e in ev] == [0, 2, 0]
+    assert [e.data["residual"] for e in ev] == [0, -1, 0]
+    assert all("pos_min" not in e.data for e in ev)  # counters tier
+    # moments tier adds the vector keys, per step
+    probe["pos_min"] = np.zeros((3, 3), np.float32)
+    probe["pos_max"] = np.ones((3, 3), np.float32)
+    probe["vel_m2"] = np.array([1.0, 2.0, 3.0], np.float32)
+    rec2 = StepRecorder()
+    record_probe_steps(rec2, 1, probe)
+    e = rec2.events("state_health")[-1]
+    assert e.data["pos_max"] == [1.0, 1.0, 1.0]
+    assert e.data["vel_m2"] == 3.0
+
+
+# -------------------------------------- off tier: bit-identical program
+
+
+def test_off_tier_emits_identical_jaxpr(tmp_path):
+    """probes=None and probes=ProbeConfig("off") must trace to the SAME
+    program, for every chunk length — the default tier is zero-cost by
+    construction, not merely cheap."""
+    import jax
+
+    drv = ServiceDriver(_jax_cfg(tmp_path))
+    drv.init_state()
+    drv._ensure_built()
+    pos, vel, ids, count = drv.state
+    for chunk in CHUNKS:
+        jaxprs = []
+        for probes in (None, ProbeConfig("off")):
+            macro, _, _ = resident.make_chunk_fn(
+                drv._rd, drv.cfg.dt, chunk, pos, vel, ids, probes=probes
+            )
+            jaxprs.append(str(jax.make_jaxpr(macro)(pos, vel, ids, count)))
+        assert jaxprs[0] == jaxprs[1], f"chunk={chunk}"
+    drv.close()
+
+
+def test_probed_run_reproduces_unprobed_trajectory(tmp_path):
+    """Counters-probed resident run vs unprobed, same seed: identical
+    particle set and count bytes — the probe observes, never perturbs.
+    The probed run must also journal one clean state_health per step."""
+    states = {}
+    recs = {}
+    for probes in ("off", "counters"):
+        drv = ServiceDriver(_jax_cfg(tmp_path, chunk=5, probes=probes))
+        drv.init_state()
+        drv.run()
+        drv.close()
+        states[probes] = drv.state
+        recs[probes] = drv.recorder
+    assert elastic.particle_set(*states["counters"]) == (
+        elastic.particle_set(*states["off"])
+    )
+    assert states["counters"][3].tobytes() == states["off"][3].tobytes()
+    assert recs["off"].events("state_health") == []
+    ev = recs["counters"].events("state_health")
+    assert [e.data["step"] for e in ev] == list(range(1, 13))
+    for e in ev:
+        assert e.data["nan_pos"] == 0 and e.data["nan_vel"] == 0
+        assert e.data["oob"] == 0 and e.data["residual"] == 0
+
+
+@pytest.mark.parametrize("tier", ["counters", "moments"])
+def test_armed_macro_jaxpr_stays_on_device(tmp_path, tier):
+    """The probe-armed macro-step is still pure device code: the scan
+    survives and no callback/infeed/outfeed primitive appears anywhere
+    in the traced program (progcheck J002's dynamic backstop for the
+    probe-armed registry entry)."""
+    import jax
+
+    from mpi_grid_redistribute_tpu.analysis.progcheck import (
+        primitive_names,
+    )
+
+    drv = ServiceDriver(_jax_cfg(tmp_path))
+    drv.init_state()
+    drv._ensure_built()
+    pos, vel, ids, count = drv.state
+    macro, _, _ = resident.make_chunk_fn(
+        drv._rd, drv.cfg.dt, 4, pos, vel, ids, probes=ProbeConfig(tier)
+    )
+    jaxpr = jax.make_jaxpr(macro)(pos, vel, ids, count)
+    names = primitive_names(jaxpr.jaxpr)
+    assert "scan" in names, "armed macro-step lost its lax.scan"
+    hostile = [
+        n for n in names
+        if "callback" in n or "infeed" in n or "outfeed" in n
+    ]
+    assert not hostile, f"host syncs traced into the probed macro: {hostile}"
+    drv.close()
+
+
+# -------------------------------- corruption fault -> alert -> recovery
+
+
+def test_state_corruption_detected_and_recovered(tmp_path):
+    """The observatory's end-to-end leg of the fault matrix: an
+    injected NaN burst is seen by the probes (state_health with the
+    exact corrupted row count), paged by nan_detected (ALERT naming the
+    step), rolled back by the supervisor (restore from a PRE-corruption
+    snapshot), and the recovered run finishes bit-identical to an
+    unfaulted reference — the injector fires once, so a second burst
+    would mean the restore resurrected corrupt state."""
+    cfg = _cfg(tmp_path, probes="counters", chunk=4)
+    sup, rec = _supervised(cfg, FaultPlan([StateCorruptionFault(6, rows=5)]))
+    verdict = sup.run()
+
+    assert verdict.ok is True and verdict.gave_up is False
+    assert verdict.restarts == 1
+    assert verdict.step == cfg.steps
+
+    fired = rec.events("fault_injected")
+    assert len(fired) == 1
+    assert fired[0].data["fault"] == "state_corruption"
+    corrupt_step = fired[0].data["step"] + 1  # corrupts the NEXT step
+
+    bursts = [
+        e for e in rec.events("state_health") if e.data["nan_pos"] > 0
+    ]
+    assert bursts, "probes never saw the injected NaN burst"
+    assert bursts[0].data["step"] == corrupt_step
+    assert bursts[0].data["nan_pos"] == 5  # exactly the corrupted rows
+
+    alerts = [
+        e for e in rec.events("alert") if e.data["rule"] == "nan_detected"
+    ]
+    assert alerts, "nan_detected never paged"
+    assert f"step {corrupt_step}" in alerts[0].data["reason"]
+
+    restores = [
+        e for e in rec.events("restore") if e.data.get("what") == "state"
+    ]
+    assert restores, "supervisor never restored state"
+    assert restores[-1].data["step"] < corrupt_step, (
+        "restored from a snapshot taken AFTER the corruption"
+    )
+
+    _assert_bit_identical(sup.driver.state, _reference_state(cfg))
+
+
+def test_state_corruption_fault_validates_rows():
+    with pytest.raises(ValueError, match="rows must be >= 1"):
+        StateCorruptionFault(3, rows=0)
+
+
+def test_state_rules_respect_restore_freshness_cut():
+    """Corruption evidence older than the newest state restore is
+    rolled-back history, not a standing finding — without the cut a
+    recovered service would page on its own journal forever. A journal
+    restore (what != "state") must NOT cut: it rolls back no state."""
+    rec = StepRecorder()
+    rec.record(
+        "state_health", step=6, live=10, nan_pos=5, nan_vel=0, oob=0,
+        residual=0,
+    )
+    mon = health.HealthMonitor(rec, rules=[health.nan_detected()])
+    assert mon.evaluate(record=False)["status"] == health.ALERT
+    rec.record("restore", what="journal", path="x")
+    assert mon.evaluate(record=False)["status"] == health.ALERT
+    rec.record("restore", what="state", step=4, path="y")
+    assert mon.evaluate(record=False)["status"] == health.OK
+
+
+# --------------------------------------- documentation drift backstop
+
+
+def test_default_rules_match_schema_table():
+    """SCHEMA.md's "Health rule table" is the authoritative contract
+    for ``default_rules()`` — name, evaluation order and severity. A
+    rule added to either side must land in the other in the same
+    commit; this test is named by both."""
+    schema = (
+        Path(health.__file__).parent / "SCHEMA.md"
+    ).read_text()
+    section = schema.split("## Health rule table")[1]
+    rows = []
+    for line in section.splitlines():
+        m = re.match(r"\|\s*`([a-z_]+)`\s*\|\s*(alert|warn)\s*\|", line)
+        if m:
+            rows.append((m.group(1), m.group(2)))
+        elif rows and not line.startswith("|"):
+            break  # contiguous table ended
+    assert rows, "health rule table not found in SCHEMA.md"
+    code = [
+        (r.name, r.severity.lower()) for r in health.default_rules()
+    ]
+    assert rows == code, (
+        "SCHEMA.md health rule table and health.default_rules() drifted"
+    )
